@@ -1,0 +1,35 @@
+"""sda-tpu: a TPU-native secure-aggregation framework.
+
+A from-scratch re-design of the capabilities of Snips SDA (the reference
+multi-party-computation system for privately summing vectors from many
+participants; see `/root/reference`, surveyed in SURVEY.md): masking,
+additive / packed-Shamir secret sharing, an untrusted broker/scheduler
+server, and client roles (participant / clerk / recipient) — with all field
+arithmetic expressed as JAX/XLA kernels (modular matmuls on the MXU, threefry
+PRNG, vmap'd participant batching) and a simulated-pod mode that maps the
+clerk committee onto a `jax.sharding.Mesh` with ICI collectives in place of
+HTTP round-trips.
+
+Layout (mirrors SURVEY.md §7's build plan):
+
+- ``sda_tpu.protocol`` — resources, scheme parameters, service seam (L0)
+- ``sda_tpu.fields``   — Z_p/Z_m math core: modular kernels, NTT/Lagrange (L1a)
+- ``sda_tpu.crypto``   — sharing/masking/encryption/signing modules (L1b)
+- ``sda_tpu.client``   — participant/clerk/recipient workflows (L2)
+- ``sda_tpu.server``   — server core, ACL, snapshot scheduler, stores (L3/L4)
+- ``sda_tpu.http``     — REST transport, both directions (L5)
+- ``sda_tpu.store``    — client-side key/identity storage (L6)
+- ``sda_tpu.cli``      — `sda` and `sdad` command-line tools (L7)
+- ``sda_tpu.mesh``     — simulated-pod device-mesh execution (TPU-native)
+- ``sda_tpu.native``   — C++ host-side kernels (CPU oracle, ChaCha20)
+
+Protocol values are i64 (reference: client/src/crypto/mod.rs:33-36), so the
+framework enables JAX x64 mode at import. Hot TPU kernels internally use
+int32/limb paths where profitable; the public dtype is int64.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

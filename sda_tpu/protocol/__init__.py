@@ -1,0 +1,70 @@
+"""L0: protocol data model, scheme parameters, and the service seam."""
+
+from .errors import (
+    InvalidCredentials,
+    InvalidRequest,
+    NotFound,
+    PermissionDenied,
+    SdaError,
+    ServerError,
+)
+from .helpers import (
+    B8,
+    B32,
+    B64,
+    Binary,
+    Labelled,
+    ResourceId,
+    Signed,
+    canonical_json,
+)
+from .crypto import (
+    AdditiveEncryptionScheme,
+    AdditiveSharing,
+    ChaChaMasking,
+    Encryption,
+    EncryptionKey,
+    FullMasking,
+    LinearMaskingScheme,
+    LinearSecretSharingScheme,
+    NoMasking,
+    PackedShamirSharing,
+    Signature,
+    SigningKey,
+    SodiumEncryption,
+    VerificationKey,
+)
+from .resources import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    AggregationStatus,
+    ClerkCandidate,
+    ClerkingJob,
+    ClerkingJobId,
+    ClerkingResult,
+    Committee,
+    EncryptionKeyId,
+    Participation,
+    ParticipationId,
+    Profile,
+    Snapshot,
+    SnapshotId,
+    SnapshotResult,
+    SnapshotStatus,
+    VerificationKeyId,
+    signed_encryption_key_from_obj,
+)
+from .methods import (
+    Pong,
+    SdaAgentService,
+    SdaAggregationService,
+    SdaBaseService,
+    SdaClerkingService,
+    SdaParticipationService,
+    SdaRecipientService,
+    SdaService,
+)
+
+__all__ = [name for name in dir() if not name.startswith("_")]

@@ -1,0 +1,283 @@
+"""Scheme parameters for the cryptographic primitives — schemes are *data*.
+
+Mirrors the reference's scheme algebra (reference: protocol/src/crypto.rs):
+ciphertext/key wrappers (:8-39), masking schemes (:43-75), secret-sharing
+schemes with derived properties (:79-155), and additive encryption schemes
+(:159-188). All scheme configuration travels in-band inside the Aggregation
+resource, so adding a scheme never changes the wire protocol shape.
+"""
+
+from __future__ import annotations
+
+from .helpers import B32, B64, Binary, TaggedEnum
+
+
+# ---------------------------------------------------------------------------
+# Ciphertexts, keys, signatures (crypto.rs:8-39)
+
+class Encryption(TaggedEnum):
+    """A ciphertext. ``Sodium`` = Curve25519+XSalsa20+Poly1305 sealed box."""
+    VARIANTS = {"Sodium": Binary}
+
+    @classmethod
+    def sodium(cls, data: bytes) -> "Encryption":
+        return cls("Sodium", Binary(data))
+
+
+class EncryptionKey(TaggedEnum):
+    """A public encryption key (32-byte Curve25519)."""
+    VARIANTS = {"Sodium": B32}
+
+
+class Signature(TaggedEnum):
+    """A detached signature (64-byte Ed25519)."""
+    VARIANTS = {"Sodium": B64}
+
+
+class SigningKey(TaggedEnum):
+    """A secret signing key (64-byte Ed25519 expanded key)."""
+    VARIANTS = {"Sodium": B64}
+
+
+class VerificationKey(TaggedEnum):
+    """A public signature-verification key (32-byte Ed25519)."""
+    VARIANTS = {"Sodium": B32}
+
+
+# ---------------------------------------------------------------------------
+# Masking schemes (crypto.rs:43-75)
+
+class LinearMaskingScheme:
+    """Masking between recipient and committee; subclasses are the variants."""
+
+    #: whether masks are produced at all (crypto.rs:66-75)
+    has_mask: bool = True
+
+    def to_obj(self):
+        raise NotImplementedError
+
+    @staticmethod
+    def from_obj(obj) -> "LinearMaskingScheme":
+        if obj == "None":
+            return NoMasking()
+        if isinstance(obj, dict) and len(obj) == 1:
+            [(variant, p)] = obj.items()
+            if variant == "Full":
+                return FullMasking(modulus=p["modulus"])
+            if variant == "ChaCha":
+                return ChaChaMasking(
+                    modulus=p["modulus"],
+                    dimension=p["dimension"],
+                    seed_bitsize=p["seed_bitsize"],
+                )
+        raise ValueError(f"unknown masking scheme {obj!r}")
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_obj() == other.to_obj()
+
+    def __hash__(self):
+        return hash(repr(self.to_obj()))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.to_obj()!r})"
+
+
+class NoMasking(LinearMaskingScheme):
+    """No masking: secrets are shared directly to the clerks."""
+    has_mask = False
+
+    def to_obj(self):
+        return "None"
+
+
+class FullMasking(LinearMaskingScheme):
+    """Per-element fresh-random mask; mask uploaded in full (O(d))."""
+
+    def __init__(self, modulus: int):
+        self.modulus = int(modulus)
+
+    def to_obj(self):
+        return {"Full": {"modulus": self.modulus}}
+
+
+class ChaChaMasking(LinearMaskingScheme):
+    """Seed-compressed masking: upload a <=256-bit seed, not an O(d) mask.
+
+    Trades upload/download bandwidth for seed-expansion compute on both
+    participant and recipient sides (crypto.rs:53-62).
+    """
+
+    def __init__(self, modulus: int, dimension: int, seed_bitsize: int):
+        self.modulus = int(modulus)
+        self.dimension = int(dimension)
+        self.seed_bitsize = int(seed_bitsize)
+
+    def to_obj(self):
+        return {
+            "ChaCha": {
+                "modulus": self.modulus,
+                "dimension": self.dimension,
+                "seed_bitsize": self.seed_bitsize,
+            }
+        }
+
+
+# ---------------------------------------------------------------------------
+# Secret-sharing schemes (crypto.rs:79-155)
+
+class LinearSecretSharingScheme:
+    """Sharing of masked secrets across the committee, with derived properties."""
+
+    #: number of secrets shared together (crypto.rs:120-126)
+    input_size: int
+    #: number of shares produced == committee size (crypto.rs:129-135)
+    output_size: int
+    #: max colluding clerks before privacy is lost (crypto.rs:138-144)
+    privacy_threshold: int
+    #: min clerk results needed to reconstruct (crypto.rs:147-153)
+    reconstruction_threshold: int
+
+    def to_obj(self):
+        raise NotImplementedError
+
+    @staticmethod
+    def from_obj(obj) -> "LinearSecretSharingScheme":
+        if isinstance(obj, dict) and len(obj) == 1:
+            [(variant, p)] = obj.items()
+            if variant == "Additive":
+                return AdditiveSharing(share_count=p["share_count"], modulus=p["modulus"])
+            if variant == "PackedShamir":
+                return PackedShamirSharing(
+                    secret_count=p["secret_count"],
+                    share_count=p["share_count"],
+                    privacy_threshold=p["privacy_threshold"],
+                    prime_modulus=p["prime_modulus"],
+                    omega_secrets=p["omega_secrets"],
+                    omega_shares=p["omega_shares"],
+                )
+        raise ValueError(f"unknown sharing scheme {obj!r}")
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_obj() == other.to_obj()
+
+    def __hash__(self):
+        return hash(repr(self.to_obj()))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.to_obj()!r})"
+
+
+class AdditiveSharing(LinearSecretSharingScheme):
+    """n-of-n additive sharing over Z_modulus (computationally cheap)."""
+
+    def __init__(self, share_count: int, modulus: int):
+        self.share_count = int(share_count)
+        self.modulus = int(modulus)
+
+    input_size = 1
+
+    @property
+    def output_size(self) -> int:
+        return self.share_count
+
+    @property
+    def privacy_threshold(self) -> int:
+        return self.share_count - 1
+
+    @property
+    def reconstruction_threshold(self) -> int:
+        return self.share_count
+
+    def to_obj(self):
+        return {"Additive": {"share_count": self.share_count, "modulus": self.modulus}}
+
+
+class PackedShamirSharing(LinearSecretSharingScheme):
+    """Packed Shamir over Z_p: k secrets per polynomial, fault-tolerant.
+
+    ``omega_secrets`` is a root of unity of power-of-2 order
+    ``secret_count + privacy_threshold + 1``; ``omega_shares`` of power-of-3
+    order ``share_count + 1`` — enabling NTT-based polynomial evaluation
+    (reference scheme parameters: protocol/src/crypto.rs:98-113; working
+    vector p=433, omega=354/150: integration-tests/tests/full_loop.rs:55-67).
+    """
+
+    def __init__(
+        self,
+        secret_count: int,
+        share_count: int,
+        privacy_threshold: int,
+        prime_modulus: int,
+        omega_secrets: int,
+        omega_shares: int,
+    ):
+        self.secret_count = int(secret_count)
+        self.share_count = int(share_count)
+        self._privacy_threshold = int(privacy_threshold)
+        self.prime_modulus = int(prime_modulus)
+        self.omega_secrets = int(omega_secrets)
+        self.omega_shares = int(omega_shares)
+
+    @property
+    def input_size(self) -> int:
+        return self.secret_count
+
+    @property
+    def output_size(self) -> int:
+        return self.share_count
+
+    @property
+    def privacy_threshold(self) -> int:
+        return self._privacy_threshold
+
+    @property
+    def reconstruction_threshold(self) -> int:
+        return self._privacy_threshold + self.secret_count
+
+    def to_obj(self):
+        return {
+            "PackedShamir": {
+                "secret_count": self.secret_count,
+                "share_count": self.share_count,
+                "privacy_threshold": self._privacy_threshold,
+                "prime_modulus": self.prime_modulus,
+                "omega_secrets": self.omega_secrets,
+                "omega_shares": self.omega_shares,
+            }
+        }
+
+
+# ---------------------------------------------------------------------------
+# Additive encryption schemes (crypto.rs:159-188)
+
+class AdditiveEncryptionScheme:
+    """Share-transport encryption scheme."""
+
+    batch_size: int = 1
+
+    def to_obj(self):
+        raise NotImplementedError
+
+    @staticmethod
+    def from_obj(obj) -> "AdditiveEncryptionScheme":
+        if obj == "Sodium":
+            return SodiumEncryption()
+        raise ValueError(f"unknown encryption scheme {obj!r}")
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_obj() == other.to_obj()
+
+    def __hash__(self):
+        return hash(repr(self.to_obj()))
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class SodiumEncryption(AdditiveEncryptionScheme):
+    """libsodium sealed box (Curve25519+XSalsa20+Poly1305), anonymous sender."""
+
+    batch_size = 1
+
+    def to_obj(self):
+        return "Sodium"

@@ -1,0 +1,45 @@
+"""Error model for the SDA protocol and services.
+
+Mirrors the error kinds the reference distinguishes (reference:
+protocol/src/errors.rs and server/src/errors.rs): permission denied,
+invalid credentials, invalid request, and generic failures — these drive
+both the server-side ACL wrapper and the HTTP status mapping
+(reference: server-http/src/lib.rs:105-122).
+"""
+
+from __future__ import annotations
+
+
+class SdaError(Exception):
+    """Base class for all protocol-level errors."""
+
+
+class PermissionDenied(SdaError):
+    """Caller is not allowed to perform the operation (ACL failure)."""
+
+    def __init__(self, message: str = "permission denied"):
+        super().__init__(message)
+
+
+class InvalidCredentials(SdaError):
+    """Authentication failed (bad or missing auth token)."""
+
+    def __init__(self, message: str = "invalid credentials"):
+        super().__init__(message)
+
+
+class InvalidRequest(SdaError):
+    """Request is malformed or violates an invariant (HTTP 400)."""
+
+
+class NotFound(SdaError):
+    """Referenced resource does not exist.
+
+    Services normally signal missing resources by returning ``None``; this
+    error is for flows where absence is fatal (e.g. "aggregation not found"
+    while creating a committee, reference: server/src/server.rs:86-99).
+    """
+
+
+class ServerError(SdaError):
+    """Internal server failure (HTTP 500)."""
