@@ -1,0 +1,119 @@
+"""ChaCha20-based deterministic mask PRG (host-side, vectorized numpy).
+
+The reference's ChaCha masking scheme derives an O(d) mask from a <=256-bit
+seed so participants upload O(1) mask data (client/src/crypto/masking/
+chacha.rs:24-77, via rand 0.3's ChaChaRng). The exact rand-0.3 stream is not
+reproduced here; sda-tpu pins its own versioned PRG spec (``CHACHA_PRG_V1``)
+with the same interface and security properties:
+
+- seed: list of u32 words (serialized as the i64 "mask" vector on the wire,
+  chacha.rs:49-53 convention);
+- key: seed words placed in key words 0..len-1, remaining words 0;
+- state: RFC-7539 constants | key(8) | block counter (word 12, from 0) |
+  words 13..15 zero; 20 rounds; output words little-endian;
+- draw stream: consecutive u64 = (word[2i] as low, word[2i+1] as high);
+- sample in [0, m): rejection below zone = floor(2^64/m)*m, then v % m.
+
+Both participant (mask generation) and recipient (mask re-expansion — the
+recipient hot loop, receive.rs:102-118) use this expansion, so the protocol
+stays self-consistent; a native C++ implementation of the same spec lives in
+sda_tpu/native.
+"""
+
+from __future__ import annotations
+
+import secrets as _secrets
+from typing import List, Sequence
+
+import numpy as np
+
+CHACHA_PRG_V1 = "sda-tpu/chacha20-prg/v1"
+
+_CONSTANTS = np.array(
+    [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574], dtype=np.uint32
+)
+
+
+def random_seed(seed_bitsize: int) -> List[int]:
+    """Fresh OS-random seed of ceil(seed_bitsize/32) u32 words (chacha.rs:29-34)."""
+    words = (seed_bitsize + 31) // 32
+    if words > 8:
+        raise ValueError("seed_bitsize > 256 unsupported: ChaCha20 keys hold 256 bits")
+    return [int.from_bytes(_secrets.token_bytes(4), "little") for _ in range(words)]
+
+
+def _rotl(x, n):
+    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+
+
+def _quarter(state, a, b, c, d):
+    state[a] += state[b]
+    state[d] = _rotl(state[d] ^ state[a], 16)
+    state[c] += state[d]
+    state[b] = _rotl(state[b] ^ state[c], 12)
+    state[a] += state[b]
+    state[d] = _rotl(state[d] ^ state[a], 8)
+    state[c] += state[d]
+    state[b] = _rotl(state[b] ^ state[c], 7)
+
+
+def chacha_block_words(seed: Sequence[int], counter0: int, nblocks: int) -> np.ndarray:
+    """[nblocks, 16] u32 keystream words for block counters counter0..+nblocks.
+
+    Vectorized: all blocks advance through the 20 rounds simultaneously.
+    """
+    if len(seed) > 8:
+        raise ValueError(
+            f"seed has {len(seed)} words; ChaCha20 keys hold at most 8 "
+            "(256 bits) — longer seeds would silently lose entropy"
+        )
+    key = np.zeros(8, dtype=np.uint32)
+    for i, w in enumerate(seed):
+        key[i] = np.uint32(w & 0xFFFFFFFF)
+    init = np.zeros((16, nblocks), dtype=np.uint32)
+    init[0:4] = _CONSTANTS[:, None]
+    init[4:12] = key[:, None]
+    init[12] = (np.arange(counter0, counter0 + nblocks)).astype(np.uint32)
+    state = init.copy()
+    with np.errstate(over="ignore"):
+        for _ in range(10):
+            # column rounds
+            _quarter(state, 0, 4, 8, 12)
+            _quarter(state, 1, 5, 9, 13)
+            _quarter(state, 2, 6, 10, 14)
+            _quarter(state, 3, 7, 11, 15)
+            # diagonal rounds
+            _quarter(state, 0, 5, 10, 15)
+            _quarter(state, 1, 6, 11, 12)
+            _quarter(state, 2, 7, 8, 13)
+            _quarter(state, 3, 4, 9, 14)
+        state += init
+    return state.T  # [nblocks, 16]
+
+
+def expand_mask(seed: Sequence[int], dimension: int, modulus: int) -> np.ndarray:
+    """Deterministic mask vector in [0, m)^d from a seed (the PRG expansion).
+
+    Rejection sampling on u64 draws; each 16-word block yields 8 draws.
+    """
+    if modulus <= 0 or modulus >= (1 << 62):
+        raise ValueError("modulus out of range")
+    m = np.uint64(modulus)
+    zone = np.uint64(((1 << 64) // modulus) * modulus - 1)  # accept v <= zone
+    out = np.empty(dimension, dtype=np.int64)
+    filled = 0
+    counter = 0
+    # over-draw slightly; rejection probability is < m/2^64
+    while filled < dimension:
+        need = dimension - filled
+        nblocks = max(1, -(-need // 8) + 1)
+        words = chacha_block_words(seed, counter, nblocks).reshape(-1)
+        counter += nblocks
+        lo = words[0::2].astype(np.uint64)
+        hi = words[1::2].astype(np.uint64)
+        v = (hi << np.uint64(32)) | lo
+        v = v[v <= zone]
+        take = min(need, v.shape[0])
+        out[filled : filled + take] = (v[:take] % m).astype(np.int64)
+        filled += take
+    return out

@@ -1,0 +1,115 @@
+"""Device-side modular arithmetic kernels (jnp, jit-friendly).
+
+All arrays carry int64 values in canonical form [0, m). On TPU int64 is
+emulated in int32 pairs, so kernels are written to (a) keep intermediates
+small enough for exactness, and (b) expose an int8-limb MXU path for the
+hot matmul (``modmatmul``), which lowers to native int8 systolic-array
+matmuls with int32 accumulation.
+
+Overflow discipline (p < 2^31 enforced by schemes):
+- direct einsum path: products < p^2 < 2^62, safe only when k*p^2 < 2^63;
+- limb path: b split as b_hi*2^16 + b_lo, products < p*2^16 < 2^47, safe
+  for contraction sizes k < 2^15.
+
+The reference computes the same algebra as scalar Rust loops over Vec<i64>
+(client/src/crypto/sharing/*.rs); the canonical-form convention here differs
+only by a final `positive()` lift (receive.rs:14-21) — values are congruent.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def canon(x, m):
+    """Canonical representative in [0, m) of any int64 residues."""
+    return jnp.mod(x, m)
+
+
+def modadd(a, b, m):
+    return jnp.mod(a + b, m)
+
+
+def modsub(a, b, m):
+    return jnp.mod(a - b, m)
+
+
+def modsum(x, m, axis=0):
+    """Sum of canonical residues along ``axis`` mod m.
+
+    Safe while n_terms * m < 2^63 (n < 2^32 for the largest 31-bit moduli) —
+    this is THE clerk kernel (reference hot loop: sharing/combiner.rs:15-30).
+    """
+    return jnp.mod(jnp.sum(x, axis=axis, dtype=jnp.int64), m)
+
+
+def _modmatmul_direct(a, b, p):
+    return jnp.mod(jnp.matmul(a, b, preferred_element_type=jnp.int64), p)
+
+
+def _modmatmul_limb(a, b, p):
+    b_hi = b >> 16
+    b_lo = b & 0xFFFF
+    hi = jnp.matmul(a, b_hi, preferred_element_type=jnp.int64)
+    lo = jnp.matmul(a, b_lo, preferred_element_type=jnp.int64)
+    return jnp.mod(jnp.mod(hi, p) * ((1 << 16) % p) + jnp.mod(lo, p), p)
+
+
+#: Largest supported modulus (exclusive): residues must fit 31 bits so the
+#: 16-bit limb split keeps every int64 intermediate exact.
+MAX_MODULUS = 1 << 31
+
+
+def modmatmul(a, b, p: int):
+    """(a @ b) mod p for canonical int64 operands; p < 2^31.
+
+    ``a`` is typically a small host-built scheme matrix ([n, m2] share or
+    [k, r] reconstruct matrix), ``b`` the batch-column data [m2, B] with B
+    huge — the MXU-shaped formulation of packed-Shamir share/reconstruct.
+    """
+    if p >= MAX_MODULUS:
+        raise ValueError(f"modulus {p} >= 2^31 unsupported by limb modmatmul")
+    k = b.shape[0]
+    if k * p * p < (1 << 62):
+        return _modmatmul_direct(a, b, p)
+    if k >= (1 << 15):
+        raise ValueError(f"contraction size {k} too large for limb modmatmul")
+    return _modmatmul_limb(a, b, p)
+
+
+def uniform_mod(key, shape, m: int):
+    """Uniform draws in [0, m) from threefry bits; m < 2^62.
+
+    64 random bits reduced mod m: statistical distance from uniform is
+    <= m / 2^64 (< 2^-33 for 31-bit moduli) — the TPU-native replacement for
+    the reference's OsRng.gen_range (additive.rs:42-44, full.rs:25-27).
+    """
+    bits = jax.random.bits(key, shape=shape + (2,), dtype=jnp.uint32)
+    v = (bits[..., 0].astype(jnp.uint64) << jnp.uint64(32)) | bits[..., 1].astype(jnp.uint64)
+    return jnp.mod(v, jnp.uint64(m)).astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# NumPy mirrors (host oracle building blocks — bit-exact same algorithms)
+
+def np_modmatmul(a: np.ndarray, b: np.ndarray, p: int) -> np.ndarray:
+    if p >= MAX_MODULUS:
+        raise ValueError(f"modulus {p} >= 2^31 unsupported by limb modmatmul")
+    a = np.asarray(a, dtype=np.int64)
+    b = np.asarray(b, dtype=np.int64)
+    k = b.shape[0]
+    if k * p * p < (1 << 62):
+        return np.matmul(a, b) % p
+    if k >= (1 << 15):
+        raise ValueError(f"contraction size {k} too large for limb modmatmul")
+    hi = np.matmul(a, b >> 16)
+    lo = np.matmul(a, b & 0xFFFF)
+    return ((hi % p) * ((1 << 16) % p) + (lo % p)) % p
+
+
+def np_modsum(x: np.ndarray, m: int, axis=0) -> np.ndarray:
+    return np.sum(np.asarray(x, dtype=np.int64), axis=axis) % m

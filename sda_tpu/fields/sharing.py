@@ -1,0 +1,117 @@
+"""Device kernels for secret sharing: additive and packed Shamir.
+
+The reference's batching layer (client/src/crypto/sharing/batched.rs:18-99)
+chunks a d-vector into ceil(d/k) batches of k secrets, shares each batch,
+and transposes shares per clerk. Here that whole layer is a reshape: the
+batch axis becomes the matmul's column axis, so sharing a participant's
+vector is ONE [n, m2] @ [m2, B] modular matmul and reconstruction is ONE
+[k, r+1] @ [r+1, B] matmul — MXU-shaped, vmap-able over participants.
+
+Functions are jit-compiled with scheme parameters static; canonical residues
+[0, m) throughout (congruent to the reference's signed representatives, cf.
+receive.rs:14-21 `positive()`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .modular import modmatmul, modsub, modsum, uniform_mod
+
+
+def batch_columns(secrets, input_size: int):
+    """[d] -> [input_size, B] column-per-batch layout (zero-padded).
+
+    Batch b holds secrets[b*k:(b+1)*k] (batched.rs:18-53 semantics).
+    """
+    d = secrets.shape[-1]
+    B = -(-d // input_size)
+    padded = jnp.zeros(secrets.shape[:-1] + (B * input_size,), secrets.dtype)
+    padded = padded.at[..., :d].set(secrets)
+    return jnp.moveaxis(
+        padded.reshape(secrets.shape[:-1] + (B, input_size)), -1, -2
+    )
+
+
+def unbatch_columns(batched, dimension: int):
+    """[k, B] -> [d], inverse of batch_columns (truncates padding)."""
+    out = jnp.moveaxis(batched, -2, -1)
+    out = out.reshape(out.shape[:-2] + (-1,))
+    return out[..., :dimension]
+
+
+# ---------------------------------------------------------------------------
+# Additive sharing (reference: client/src/crypto/sharing/additive.rs)
+
+@functools.partial(jax.jit, static_argnames=("modulus",))
+def additive_share_from_randomness(secrets, draws, *, modulus: int):
+    """[..., d] secrets + [..., n-1, d] draws -> [..., n, d] shares.
+
+    Last share is secret minus the sum of the draws (additive.rs:32-52);
+    split out so the CPU oracle can be fed identical randomness.
+    """
+    last = modsub(secrets, modsum(draws, modulus, axis=-2), modulus)
+    return jnp.concatenate([draws, last[..., None, :]], axis=-2)
+
+
+def additive_share(key, secrets, *, share_count: int, modulus: int):
+    """[..., d] secrets -> [..., n, d] shares with fresh threefry draws."""
+    d = secrets.shape[-1]
+    draws = uniform_mod(key, secrets.shape[:-1] + (share_count - 1, d), modulus)
+    return additive_share_from_randomness(secrets, draws, modulus=modulus)
+
+
+@functools.partial(jax.jit, static_argnames=("modulus",))
+def combine(shares, *, modulus: int):
+    """Elementwise modular sum across the leading axis — the clerk hot kernel
+    (combiner.rs:15-30) and the additive reconstructor (additive.rs:55-73)."""
+    return modsum(shares, modulus, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Packed Shamir (reference: packed_shamir.rs via the tss crate; matrices
+# built host-side in sda_tpu.fields.numtheory)
+
+@functools.partial(jax.jit, static_argnames=("prime", "secret_count"), donate_argnums=())
+def packed_share_from_randomness(secrets, randomness, share_matrix, *, prime: int,
+                                 secret_count: int):
+    """Share [..., d] secrets given explicit [..., t, B] randomness.
+
+    values column = [0; k secrets; t randomness]; shares = M @ values.
+    Split out so the CPU oracle can be fed identical randomness for
+    bit-exactness tests.
+    """
+    sk = batch_columns(secrets, secret_count)                    # [..., k, B]
+    zeros = jnp.zeros(sk.shape[:-2] + (1,) + sk.shape[-1:], sk.dtype)
+    values = jnp.concatenate([zeros, sk, randomness], axis=-2)   # [..., m2, B]
+    return modmatmul(share_matrix, values, prime)                # [..., n, B]
+
+
+def packed_share(key, secrets, share_matrix, *, prime: int, secret_count: int,
+                 privacy_threshold: int):
+    """Share with fresh threefry randomness; returns [..., n, B] clerk rows."""
+    d = secrets.shape[-1]
+    B = -(-d // secret_count)
+    randomness = uniform_mod(
+        key, secrets.shape[:-1] + (privacy_threshold, B), prime
+    )
+    return packed_share_from_randomness(
+        secrets, randomness, share_matrix, prime=prime, secret_count=secret_count
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("prime", "dimension"))
+def packed_reconstruct(shares, recon_matrix, *, prime: int, dimension: int):
+    """[r, B] surviving clerk share rows -> [d] secrets.
+
+    recon_matrix is built for the surviving index set
+    (numtheory.packed_reconstruct_matrix); the implicit point-1 zero row is
+    prepended here.
+    """
+    zeros = jnp.zeros((1,) + shares.shape[1:], shares.dtype)
+    values = jnp.concatenate([zeros, shares], axis=0)            # [r+1, B]
+    secrets = modmatmul(recon_matrix, values, prime)             # [k, B]
+    return unbatch_columns(secrets, dimension)

@@ -2,14 +2,23 @@
 
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding logic is
 exercised without TPU hardware (the driver separately dry-runs the multichip
-path; benches run on the real chip). Must run before jax is imported.
+path; real-chip perf is bench.py's job).
+
+Environment quirk: this image's sitecustomize registers an `axon` TPU PJRT
+plugin in every interpreter and *programmatically* sets jax_platforms, so the
+JAX_PLATFORMS env var alone is ignored — we must override via jax.config
+before any backend initializes. XLA_FLAGS is read at backend init, which
+hasn't happened yet when conftest loads.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

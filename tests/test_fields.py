@@ -1,0 +1,213 @@
+"""Property tests for the field kernels — the discipline the reference lacks.
+
+Covers: share∘reconstruct == id for the golden p=433/omega=354/150 vector
+(reference fixture: integration-tests/tests/full_loop.rs:55-67), arbitrary
+surviving subsets, device-vs-oracle bit-exactness, large-prime limb paths,
+PRG range, and scheme-parameter generation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sda_tpu.fields import (
+    additive_share,
+    additive_share_from_randomness,
+    chacha,
+    combine,
+    modmatmul,
+    np_modmatmul,
+    numtheory,
+    oracle,
+    packed_reconstruct,
+    packed_share,
+    packed_share_from_randomness,
+    uniform_mod,
+)
+from sda_tpu.protocol import PackedShamirSharing
+
+GOLDEN = PackedShamirSharing(
+    secret_count=3, share_count=8, privacy_threshold=4,
+    prime_modulus=433, omega_secrets=354, omega_shares=150,
+)
+
+
+def golden_matrices():
+    M = numtheory.packed_share_matrix(3, 8, 4, 433, 354, 150)
+    return M
+
+
+def test_golden_scheme_validates():
+    numtheory.validate_packed_scheme(3, 8, 4, 433, 354, 150)
+    with pytest.raises(ValueError):
+        numtheory.validate_packed_scheme(3, 8, 5, 433, 354, 150)  # m2 not pow2
+    with pytest.raises(ValueError):
+        numtheory.validate_packed_scheme(3, 8, 4, 433, 354, 151)  # wrong order
+
+
+def test_packed_share_reconstruct_roundtrip_all_indices():
+    key = jax.random.PRNGKey(0)
+    secrets = jnp.array([1, 2, 3, 4], dtype=jnp.int64)
+    M = jnp.asarray(golden_matrices())
+    shares = packed_share(key, secrets, M, prime=433, secret_count=3, privacy_threshold=4)
+    assert shares.shape == (8, 2)  # 8 clerks, ceil(4/3)=2 batches
+
+    L = numtheory.packed_reconstruct_matrix(3, 8, 4, 433, 354, 150, tuple(range(8)))
+    out = packed_reconstruct(shares, jnp.asarray(L), prime=433, dimension=4)
+    np.testing.assert_array_equal(np.asarray(out), [1, 2, 3, 4])
+
+
+@pytest.mark.parametrize("subset", [
+    (0, 1, 2, 3, 4, 5, 6),       # minimal: t+k = 7
+    (1, 3, 4, 5, 6, 7, 0),       # order should not matter
+    (7, 6, 5, 4, 3, 2, 1),
+    (0, 1, 2, 3, 4, 5, 6, 7),    # superset
+])
+def test_packed_reconstruct_from_subsets(subset):
+    """Fault tolerance: any t+k of n shares reconstruct (crypto.rs:146-153)."""
+    key = jax.random.PRNGKey(42)
+    secrets = jnp.array([10, 20, 30, 40, 50], dtype=jnp.int64)
+    M = jnp.asarray(golden_matrices())
+    shares = packed_share(key, secrets, M, prime=433, secret_count=3, privacy_threshold=4)
+    L = numtheory.packed_reconstruct_matrix(3, 8, 4, 433, 354, 150, subset)
+    picked = jnp.stack([shares[i] for i in subset])
+    out = packed_reconstruct(picked, jnp.asarray(L), prime=433, dimension=5)
+    np.testing.assert_array_equal(np.asarray(out), [10, 20, 30, 40, 50])
+
+
+def test_packed_reconstruct_too_few_shares():
+    with pytest.raises(ValueError):
+        numtheory.packed_reconstruct_matrix(3, 8, 4, 433, 354, 150, (0, 1, 2, 3, 4, 5))
+
+
+def test_additivity_of_shares():
+    """Share-wise sums reconstruct to the sum of secrets — the protocol's core
+    linearity (clerk combine, combiner.rs:15-30)."""
+    key1, key2 = jax.random.split(jax.random.PRNGKey(7))
+    a = jnp.array([1, 2, 3, 4], dtype=jnp.int64)
+    b = jnp.array([1, 2, 3, 4], dtype=jnp.int64)
+    M = jnp.asarray(golden_matrices())
+    sa = packed_share(key1, a, M, prime=433, secret_count=3, privacy_threshold=4)
+    sb = packed_share(key2, b, M, prime=433, secret_count=3, privacy_threshold=4)
+    summed = combine(jnp.stack([sa, sb]), modulus=433)
+    L = numtheory.packed_reconstruct_matrix(3, 8, 4, 433, 354, 150, tuple(range(8)))
+    out = packed_reconstruct(summed, jnp.asarray(L), prime=433, dimension=4)
+    np.testing.assert_array_equal(np.asarray(out), [2, 4, 6, 8])
+
+
+def test_device_matches_oracle_bit_exact():
+    """Same randomness -> identical shares from jnp kernels and numpy oracle."""
+    rng = np.random.default_rng(0)
+    secrets = rng.integers(0, 433, size=17)
+    B = -(-17 // 3)
+    randomness = rng.integers(0, 433, size=(4, B))
+    M = jnp.asarray(golden_matrices())
+    dev = packed_share_from_randomness(
+        jnp.asarray(secrets), jnp.asarray(randomness), M, prime=433, secret_count=3
+    )
+    orc = oracle.packed_share_from_randomness(secrets, randomness, GOLDEN)
+    np.testing.assert_array_equal(np.asarray(dev), orc)
+
+    # additive path: device kernel vs oracle on identical draws
+    draws = rng.integers(0, 433, size=(2, 17))
+    dev_add = additive_share_from_randomness(
+        jnp.asarray(secrets), jnp.asarray(draws), modulus=433
+    )
+    orc_add = oracle.additive_share_from_randomness(secrets, draws, 433)
+    np.testing.assert_array_equal(np.asarray(dev_add), orc_add)
+    np.testing.assert_array_equal(oracle.combine(orc_add, 433), secrets % 433)
+
+
+def test_additive_share_reconstruct():
+    key = jax.random.PRNGKey(3)
+    secrets = jnp.arange(100, dtype=jnp.int64) % 433
+    shares = additive_share(key, secrets, share_count=5, modulus=433)
+    assert shares.shape == (5, 100)
+    np.testing.assert_array_equal(np.asarray(combine(shares, modulus=433)), np.asarray(secrets))
+    # every share uniform-ish in range
+    assert int(shares.min()) >= 0 and int(shares.max()) < 433
+
+
+def test_vmapped_participants():
+    """Participant parallelism = vmap over the leading axis (SURVEY §2.4)."""
+    key = jax.random.PRNGKey(9)
+    P, d = 6, 10
+    secrets = jnp.tile(jnp.arange(d, dtype=jnp.int64)[None, :], (P, 1))
+    keys = jax.random.split(key, P)
+    M = jnp.asarray(golden_matrices())
+    share_fn = lambda k, s: packed_share(k, s, M, prime=433, secret_count=3, privacy_threshold=4)
+    shares = jax.vmap(share_fn)(keys, secrets)            # [P, n, B]
+    summed = combine(shares, modulus=433)                 # [n, B]
+    L = numtheory.packed_reconstruct_matrix(3, 8, 4, 433, 354, 150, tuple(range(8)))
+    out = packed_reconstruct(summed, jnp.asarray(L), prime=433, dimension=d)
+    np.testing.assert_array_equal(np.asarray(out), (np.arange(d) * P) % 433)
+
+
+def test_large_prime_limb_path():
+    """31-bit prime exercises the limb modmatmul; checked against python ints."""
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, min_modulus_bits=30)
+    assert p.bit_length() >= 30 and numtheory.is_prime(p)
+    scheme = PackedShamirSharing(3, 8, t, p, w2, w3)
+    key = jax.random.PRNGKey(1)
+    secrets = jnp.array([p - 1, 0, 123456789, p - 2, 17], dtype=jnp.int64)
+    M = jnp.asarray(numtheory.packed_share_matrix(3, 8, t, p, w2, w3))
+    shares = packed_share(key, secrets, M, prime=p, secret_count=3, privacy_threshold=t)
+    L = numtheory.packed_reconstruct_matrix(3, 8, t, p, w2, w3, (0, 2, 3, 5, 6, 7, 1))
+    picked = jnp.stack([shares[i] for i in (0, 2, 3, 5, 6, 7, 1)])
+    out = packed_reconstruct(picked, jnp.asarray(L), prime=p, dimension=5)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(secrets))
+
+    # cross-check one matmul against exact python ints
+    a = np.asarray(M)[:2, :]
+    b = np.random.default_rng(5).integers(0, p, size=(a.shape[1], 3))
+    expect = [[sum(int(a[i, k]) * int(b[k, j]) for k in range(a.shape[1])) % p
+               for j in range(3)] for i in range(2)]
+    got = np_modmatmul(a, b, p)
+    np.testing.assert_array_equal(got, expect)
+    got_dev = modmatmul(jnp.asarray(a), jnp.asarray(b), p)
+    np.testing.assert_array_equal(np.asarray(got_dev), expect)
+
+
+def test_uniform_mod_range_and_determinism():
+    key = jax.random.PRNGKey(11)
+    draws = uniform_mod(key, (1000,), 433)
+    assert int(draws.min()) >= 0 and int(draws.max()) < 433
+    draws2 = uniform_mod(key, (1000,), 433)
+    np.testing.assert_array_equal(np.asarray(draws), np.asarray(draws2))
+    # coarse uniformity: all residue classes hit for small modulus
+    assert len(np.unique(np.asarray(uniform_mod(key, (5000,), 7)))) == 7
+
+
+def test_chacha_prg_deterministic_and_in_range():
+    seed = [0xDEADBEEF, 0x12345678, 0x9ABCDEF0, 0x0F0F0F0F]
+    m1 = chacha.expand_mask(seed, 1000, 433)
+    m2 = chacha.expand_mask(seed, 1000, 433)
+    np.testing.assert_array_equal(m1, m2)
+    assert m1.min() >= 0 and m1.max() < 433
+    m3 = chacha.expand_mask([1, 2, 3, 4], 1000, 433)
+    assert not np.array_equal(m1, m3)
+    # prefix stability: longer expansion extends shorter one
+    np.testing.assert_array_equal(chacha.expand_mask(seed, 100, 433), m1[:100])
+
+
+def test_chacha_known_vector():
+    """Pin the ChaCha20 permutation: all-zero key/counter block 0, LE words."""
+    w = chacha.chacha_block_words([0] * 8, 0, 1)[0]
+    assert w.dtype == np.uint32
+    # first words of the standard ChaCha20 zero-key keystream (block 0)
+    assert int(w[0]) == 0xADE0B876
+    assert int(w[1]) == 0x903DF1A0
+    w2 = chacha.chacha_block_words([0] * 8, 0, 2)
+    np.testing.assert_array_equal(w, w2[0])  # counter-parallel generation consistent
+    with pytest.raises(ValueError):
+        chacha.chacha_block_words([0] * 9, 0, 1)  # oversized seed rejected
+
+
+def test_generate_packed_params():
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8)
+    numtheory.validate_packed_scheme(3, 8, t, p, w2, w3)
+    assert t == 4  # next_pow2(3+2)=8 -> t=8-3-1
+    with pytest.raises(ValueError):
+        numtheory.generate_packed_params(3, 7)  # 8 not a power of 3
