@@ -1,0 +1,125 @@
+"""Crypto module core: keypairs, keystore interface, RNG seeds.
+
+Mirrors the reference's CryptoModule/Keystore plumbing
+(client/src/crypto/mod.rs:33-66): type aliases Secret=Mask=Share=i64 become
+int64 numpy/jnp arrays; the keystore stores encryption and signature
+keypairs by id and is shared between the crypto module and the client store.
+"""
+
+from __future__ import annotations
+
+import abc
+import secrets as _secrets
+from typing import Optional
+
+import jax
+
+from ..protocol import (
+    B32,
+    B64,
+    EncryptionKey,
+    EncryptionKeyId,
+    SigningKey,
+    VerificationKey,
+    VerificationKeyId,
+)
+
+
+class DecryptionKey:
+    """Secret half of an encryption keypair (Curve25519, 32 bytes)."""
+
+    __slots__ = ("variant", "value")
+
+    def __init__(self, variant: str, value: B32):
+        self.variant = variant
+        self.value = value
+
+    def to_obj(self):
+        return {self.variant: self.value.to_obj()}
+
+    @classmethod
+    def from_obj(cls, obj):
+        [(variant, payload)] = obj.items()
+        return cls(variant, B32.from_obj(payload))
+
+
+class EncryptionKeypair:
+    """Public + secret encryption key (encryption/mod.rs:12-17)."""
+
+    __slots__ = ("ek", "dk")
+
+    def __init__(self, ek: EncryptionKey, dk: DecryptionKey):
+        self.ek = ek
+        self.dk = dk
+
+    def to_obj(self):
+        return {"ek": self.ek.to_obj(), "dk": self.dk.to_obj()}
+
+    @classmethod
+    def from_obj(cls, obj):
+        return cls(EncryptionKey.from_obj(obj["ek"]), DecryptionKey.from_obj(obj["dk"]))
+
+
+class SignatureKeypair:
+    """Verification + signing key (signing/mod.rs:20-25)."""
+
+    __slots__ = ("vk", "sk")
+
+    def __init__(self, vk: VerificationKey, sk: SigningKey):
+        self.vk = vk
+        self.sk = sk
+
+    def to_obj(self):
+        return {"vk": self.vk.to_obj(), "sk": self.sk.to_obj()}
+
+    @classmethod
+    def from_obj(cls, obj):
+        return cls(VerificationKey.from_obj(obj["vk"]), SigningKey.from_obj(obj["sk"]))
+
+
+class Keystore(abc.ABC):
+    """Typed keypair storage (client/src/crypto/mod.rs:43-52).
+
+    Implementations: in-memory (tests), file-based (sda_tpu.store.Filebased).
+    """
+
+    @abc.abstractmethod
+    def put_encryption_keypair(self, id: EncryptionKeyId, kp: EncryptionKeypair) -> None: ...
+
+    @abc.abstractmethod
+    def get_encryption_keypair(self, id: EncryptionKeyId) -> Optional[EncryptionKeypair]: ...
+
+    @abc.abstractmethod
+    def put_signature_keypair(self, id: VerificationKeyId, kp: SignatureKeypair) -> None: ...
+
+    @abc.abstractmethod
+    def get_signature_keypair(self, id: VerificationKeyId) -> Optional[SignatureKeypair]: ...
+
+
+class MemoryKeystore(Keystore):
+    def __init__(self):
+        self._enc = {}
+        self._sig = {}
+
+    def put_encryption_keypair(self, id, kp):
+        self._enc[id] = kp
+
+    def get_encryption_keypair(self, id):
+        return self._enc.get(id)
+
+    def put_signature_keypair(self, id, kp):
+        self._sig[id] = kp
+
+    def get_signature_keypair(self, id):
+        return self._sig.get(id)
+
+
+def fresh_prng_key() -> jax.Array:
+    """Threefry key seeded from OS entropy — the device-side randomness root.
+
+    Replaces the reference's per-call OsRng (additive.rs:17, full.rs:16):
+    bulk share/mask randomness is generated on-device from a 63-bit
+    OS-entropy seed per operation (PRNGKey takes a signed int64).
+    """
+    seed = int.from_bytes(_secrets.token_bytes(8), "little") & ((1 << 63) - 1)
+    return jax.random.PRNGKey(seed)

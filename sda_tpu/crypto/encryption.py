@@ -1,0 +1,85 @@
+"""Share-transport encryption: sealed boxes over varint-packed shares.
+
+Reference: client/src/crypto/encryption/{mod,sodium}.rs — shares are
+zigzag-varint encoded then sealed to the receiver's Curve25519 key
+(anonymous sender). The varint packing is part of the wire format and is
+kept bit-compatible (sodium.rs:36-45).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..protocol import (
+    AdditiveEncryptionScheme,
+    Binary,
+    Encryption,
+    EncryptionKey,
+    EncryptionKeyId,
+    SodiumEncryption,
+)
+from . import sodium, varint
+from .core import DecryptionKey, EncryptionKeypair, Keystore
+
+
+class ShareEncryptor:
+    def encrypt(self, shares: Sequence[int]) -> Encryption:
+        raise NotImplementedError
+
+
+class ShareDecryptor:
+    def decrypt(self, encryption: Encryption) -> np.ndarray:
+        raise NotImplementedError
+
+
+class SodiumEncryptor(ShareEncryptor):
+    def __init__(self, ek: EncryptionKey):
+        if ek.variant != "Sodium":
+            raise ValueError(f"unsupported encryption key variant {ek.variant}")
+        self._pk = ek.value.data
+
+    def encrypt(self, shares):
+        payload = varint.encode(np.asarray(shares, dtype=np.int64))
+        return Encryption("Sodium", Binary(sodium.seal(payload, self._pk)))
+
+
+class SodiumDecryptor(ShareDecryptor):
+    def __init__(self, key_id: EncryptionKeyId, keystore: Keystore):
+        keypair = keystore.get_encryption_keypair(key_id)
+        if keypair is None:
+            raise ValueError("could not load keypair for decryption")
+        self._pk = keypair.ek.value.data
+        self._sk = keypair.dk.value.data
+
+    def decrypt(self, encryption):
+        if encryption.variant != "Sodium":
+            raise ValueError(f"unsupported encryption variant {encryption.variant}")
+        payload = sodium.seal_open(encryption.value.data, self._pk, self._sk)
+        return varint.decode(payload)
+
+
+def new_share_encryptor(ek: EncryptionKey, scheme: AdditiveEncryptionScheme) -> ShareEncryptor:
+    if isinstance(scheme, SodiumEncryption):
+        return SodiumEncryptor(ek)
+    raise ValueError(f"unknown encryption scheme {scheme!r}")
+
+
+def new_share_decryptor(
+    key_id: EncryptionKeyId, scheme: AdditiveEncryptionScheme, keystore: Keystore
+) -> ShareDecryptor:
+    if isinstance(scheme, SodiumEncryption):
+        return SodiumDecryptor(key_id, keystore)
+    raise ValueError(f"unknown encryption scheme {scheme!r}")
+
+
+def new_encryption_keypair() -> EncryptionKeypair:
+    """Fresh Curve25519 keypair wrapped in protocol types (sodium.rs:95-109)."""
+    from ..protocol import B32
+
+    pk, sk = sodium.box_keypair()
+    return EncryptionKeypair(
+        ek=EncryptionKey("Sodium", B32(pk)),
+        dk=DecryptionKey("Sodium", B32(sk)),
+    )

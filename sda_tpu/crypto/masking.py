@@ -1,0 +1,138 @@
+"""Scheme-dispatched masking: None / Full / ChaCha.
+
+Reference semantics (client/src/crypto/masking/): the participant masks its
+secrets so the committee only ever sees ``secret + mask`` while the recipient
+gets the mask (encrypted); unmasking subtracts the combined masks from the
+reconstructed combined masked secrets.
+
+- None (none.rs): empty mask, identity.
+- Full (full.rs): per-element fresh uniform mask, uploaded in full — here
+  generated on-device by threefry.
+- ChaCha (chacha.rs): the uploaded "mask" is the PRG *seed* (u32 words,
+  serialized as i64s); both sides expand it with the ChaCha20 PRG
+  (sda_tpu.fields.chacha — versioned spec CHACHA_PRG_V1).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import fields
+from ..fields import chacha
+from ..protocol import (
+    ChaChaMasking,
+    FullMasking,
+    LinearMaskingScheme,
+    NoMasking,
+)
+from . import rand
+from .sharing import mod_combine
+
+
+class SecretMasker:
+    def mask(self, secrets: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (mask-to-upload, masked secrets)."""
+        raise NotImplementedError
+
+
+class MaskCombiner:
+    def combine(self, masks: Sequence[np.ndarray]) -> np.ndarray:
+        """Sum uploaded masks (expanding seeds where applicable)."""
+        raise NotImplementedError
+
+
+class SecretUnmasker:
+    def unmask(self, mask: np.ndarray, masked: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class NoneMasker(SecretMasker, MaskCombiner, SecretUnmasker):
+    def mask(self, secrets):
+        return np.zeros(0, dtype=np.int64), np.asarray(secrets, dtype=np.int64)
+
+    def combine(self, masks):
+        assert all(len(m) == 0 for m in masks)
+        return np.zeros(0, dtype=np.int64)
+
+    def unmask(self, mask, masked):
+        assert len(mask) == 0
+        return np.asarray(masked, dtype=np.int64)
+
+
+class FullMasker(SecretMasker, MaskCombiner, SecretUnmasker):
+    def __init__(self, modulus: int):
+        self.modulus = modulus
+
+    def mask(self, secrets):
+        arr = np.asarray(secrets, dtype=np.int64)
+        masks = rand.uniform(arr.shape, self.modulus)
+        masked = np.asarray(
+            fields.modadd(jnp.asarray(arr), jnp.asarray(masks), self.modulus)
+        )
+        return masks, masked
+
+    def combine(self, masks):
+        return mod_combine(masks, self.modulus)
+
+    def unmask(self, mask, masked):
+        return np.asarray(
+            fields.modsub(
+                jnp.asarray(np.asarray(masked, dtype=np.int64)),
+                jnp.asarray(np.asarray(mask, dtype=np.int64)),
+                self.modulus,
+            )
+        )
+
+
+class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
+    def __init__(self, modulus: int, dimension: int, seed_bitsize: int):
+        self.modulus = modulus
+        self.dimension = dimension
+        self.seed_bitsize = seed_bitsize
+
+    def mask(self, secrets):
+        secrets = np.asarray(secrets, dtype=np.int64)
+        assert secrets.shape == (self.dimension,)
+        seed = chacha.random_seed(self.seed_bitsize)
+        mask_vec = chacha.expand_mask(seed, self.dimension, self.modulus)
+        masked = (secrets + mask_vec) % self.modulus
+        return np.asarray(seed, dtype=np.int64), masked
+
+    def combine(self, seeds):
+        """Re-expand every participant's seed — the recipient hot loop
+        (receive.rs:102-118 for the ChaCha case, chacha.rs:57-77)."""
+        result = np.zeros(self.dimension, dtype=np.int64)
+        for seed in seeds:
+            expanded = chacha.expand_mask(
+                [int(w) for w in np.asarray(seed)], self.dimension, self.modulus
+            )
+            result = (result + expanded) % self.modulus
+        return result
+
+    def unmask(self, mask, masked):
+        return (np.asarray(masked, dtype=np.int64) - np.asarray(mask, dtype=np.int64)) % self.modulus
+
+
+def new_secret_masker(scheme: LinearMaskingScheme) -> SecretMasker:
+    return _dispatch(scheme)
+
+
+def new_mask_combiner(scheme: LinearMaskingScheme) -> MaskCombiner:
+    return _dispatch(scheme)
+
+
+def new_secret_unmasker(scheme: LinearMaskingScheme) -> SecretUnmasker:
+    return _dispatch(scheme)
+
+
+def _dispatch(scheme: LinearMaskingScheme):
+    if isinstance(scheme, NoMasking):
+        return NoneMasker()
+    if isinstance(scheme, FullMasking):
+        return FullMasker(scheme.modulus)
+    if isinstance(scheme, ChaChaMasking):
+        return ChaChaMasker(scheme.modulus, scheme.dimension, scheme.seed_bitsize)
+    raise ValueError(f"unknown masking scheme {scheme!r}")

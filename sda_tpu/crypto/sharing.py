@@ -1,0 +1,139 @@
+"""Scheme-dispatched sharing: generators, combiner, reconstructors.
+
+The role-level interface of the reference (client/src/crypto/sharing/mod.rs:
+ShareGenerator :14-17, ShareCombiner :23-25, SecretReconstructor :31-33),
+re-based on the TPU kernels in sda_tpu.fields: additive sharing is a fused
+draw-and-subtract; packed Shamir is a cached share-matrix matmul; both are
+already batched over the full vector dimension (the reference's per-batch
+loop, batched.rs:18-99, is a reshape here).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import fields
+from ..fields import numtheory
+from ..protocol import (
+    AdditiveSharing,
+    LinearSecretSharingScheme,
+    PackedShamirSharing,
+)
+from . import rand
+
+
+def mod_combine(vectors: Sequence[np.ndarray], modulus: int) -> np.ndarray:
+    """Elementwise modular sum across participants — the clerk kernel
+    (combiner.rs:15-30); shared by share- and mask-combining."""
+    vecs = [np.asarray(v, dtype=np.int64) for v in vectors]
+    if not vecs:
+        return np.zeros(0, dtype=np.int64)
+    return np.asarray(fields.combine(jnp.asarray(np.stack(vecs)), modulus=modulus))
+
+
+class ShareGenerator:
+    def generate(self, secrets: Sequence[int]) -> List[np.ndarray]:
+        """Secrets vector -> per-clerk share vectors (len == output_size)."""
+        raise NotImplementedError
+
+
+class ShareCombiner:
+    def __init__(self, modulus: int):
+        self.modulus = modulus
+
+    def combine(self, share_vectors: Sequence[np.ndarray]) -> np.ndarray:
+        return mod_combine(share_vectors, self.modulus)
+
+
+class SecretReconstructor:
+    def reconstruct(self, indexed_shares: Sequence[Tuple[int, np.ndarray]]) -> np.ndarray:
+        """(clerk index, share vector) pairs -> secrets vector."""
+        raise NotImplementedError
+
+
+class AdditiveShareGenerator(ShareGenerator):
+    def __init__(self, scheme: AdditiveSharing):
+        self.scheme = scheme
+
+    def generate(self, secrets):
+        arr = np.asarray(secrets, dtype=np.int64)
+        draws = rand.uniform((self.scheme.share_count - 1, arr.shape[-1]), self.scheme.modulus)
+        shares = fields.additive_share_from_randomness(
+            jnp.asarray(arr), jnp.asarray(draws), modulus=self.scheme.modulus
+        )
+        return list(np.asarray(shares))
+
+
+class AdditiveReconstructor(SecretReconstructor):
+    def __init__(self, scheme: AdditiveSharing):
+        self.scheme = scheme
+
+    def reconstruct(self, indexed_shares):
+        return mod_combine([v for (_, v) in indexed_shares], self.scheme.modulus)
+
+
+class PackedShamirShareGenerator(ShareGenerator):
+    def __init__(self, scheme: PackedShamirSharing):
+        self.scheme = scheme
+        self._M = jnp.asarray(numtheory.packed_share_matrix(
+            scheme.secret_count, scheme.share_count, scheme.privacy_threshold,
+            scheme.prime_modulus, scheme.omega_secrets, scheme.omega_shares,
+        ))
+
+    def generate(self, secrets):
+        s = self.scheme
+        arr = np.asarray(secrets, dtype=np.int64)
+        B = -(-arr.shape[-1] // s.secret_count)
+        randomness = rand.uniform((s.privacy_threshold, B), s.prime_modulus)
+        shares = fields.packed_share_from_randomness(
+            jnp.asarray(arr), jnp.asarray(randomness), self._M,
+            prime=s.prime_modulus, secret_count=s.secret_count,
+        )
+        return list(np.asarray(shares))
+
+
+class PackedShamirReconstructor(SecretReconstructor):
+    def __init__(self, scheme: PackedShamirSharing, dimension: int):
+        self.scheme = scheme
+        self.dimension = dimension
+
+    def reconstruct(self, indexed_shares):
+        s = self.scheme
+        indices = tuple(int(i) for (i, _) in indexed_shares)
+        L = jnp.asarray(numtheory.packed_reconstruct_matrix(
+            s.secret_count, s.share_count, s.privacy_threshold,
+            s.prime_modulus, s.omega_secrets, s.omega_shares, indices,
+        ))
+        stacked = jnp.asarray(np.stack([np.asarray(v, dtype=np.int64) for (_, v) in indexed_shares]))
+        return np.asarray(fields.packed_reconstruct(
+            stacked, L, prime=s.prime_modulus, dimension=self.dimension
+        ))
+
+
+def new_share_generator(scheme: LinearSecretSharingScheme) -> ShareGenerator:
+    if isinstance(scheme, AdditiveSharing):
+        return AdditiveShareGenerator(scheme)
+    if isinstance(scheme, PackedShamirSharing):
+        return PackedShamirShareGenerator(scheme)
+    raise ValueError(f"unknown sharing scheme {scheme!r}")
+
+
+def new_share_combiner(scheme: LinearSecretSharingScheme) -> ShareCombiner:
+    if isinstance(scheme, AdditiveSharing):
+        return ShareCombiner(scheme.modulus)
+    if isinstance(scheme, PackedShamirSharing):
+        return ShareCombiner(scheme.prime_modulus)
+    raise ValueError(f"unknown sharing scheme {scheme!r}")
+
+
+def new_secret_reconstructor(
+    scheme: LinearSecretSharingScheme, dimension: int
+) -> SecretReconstructor:
+    if isinstance(scheme, AdditiveSharing):
+        return AdditiveReconstructor(scheme)
+    if isinstance(scheme, PackedShamirSharing):
+        return PackedShamirReconstructor(scheme, dimension)
+    raise ValueError(f"unknown sharing scheme {scheme!r}")

@@ -1,0 +1,58 @@
+"""Vectorized zigzag-varint codec for i64 share vectors.
+
+Wire format matches the reference's share encoding: each i64 is zigzag-mapped
+to u64 then LEB128-encoded with 7-bit groups and continuation bits (the
+integer-encoding crate's VarInt for signed types, used inside sealed boxes at
+client/src/crypto/encryption/sodium.rs:36-45, 84-90). Implemented in numpy
+over the whole vector at once — no Python-per-element loops — so encoding a
+million-share payload stays in the tens of milliseconds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MAX_BYTES = 10  # 64 bits / 7 bits per byte, rounded up
+
+
+def encode(values: np.ndarray) -> bytes:
+    """[N] int64 -> varint bytes (zigzag + LEB128)."""
+    v = np.asarray(values, dtype=np.int64)
+    u = ((v.astype(np.uint64) << np.uint64(1)) ^ (v >> np.int64(63)).astype(np.uint64))
+    # bytes needed: 1 + #{j in 1..9 : u >= 2^(7j)}
+    nbytes = np.ones(v.shape, dtype=np.int64)
+    for j in range(1, _MAX_BYTES):
+        nbytes += (u >= np.uint64(1 << (7 * j))).astype(np.int64)
+    # 7-bit groups with continuation bits
+    j_idx = np.arange(_MAX_BYTES, dtype=np.uint64)
+    groups = (u[:, None] >> (np.uint64(7) * j_idx)) & np.uint64(0x7F)
+    cont = (j_idx[None, :] < (nbytes[:, None] - 1)).astype(np.uint64) * np.uint64(0x80)
+    mat = (groups | cont).astype(np.uint8)
+    mask = j_idx[None, :] < nbytes[:, None].astype(np.uint64)
+    return mat[mask].tobytes()
+
+
+def decode(data: bytes) -> np.ndarray:
+    """varint bytes -> [N] int64; raises ValueError on malformed input."""
+    b = np.frombuffer(data, dtype=np.uint8)
+    if b.size == 0:
+        return np.zeros(0, dtype=np.int64)
+    is_last = (b & 0x80) == 0
+    if not is_last[-1]:
+        raise ValueError("truncated varint stream (trailing continuation bit)")
+    ends = np.nonzero(is_last)[0]
+    starts = np.concatenate([[0], ends[:-1] + 1])
+    lengths = ends - starts + 1
+    if lengths.max() > _MAX_BYTES:
+        raise ValueError("varint longer than 10 bytes")
+    # a 10th byte may only carry the single remaining bit of a u64; anything
+    # larger would silently wrap out of the 64-bit accumulator
+    ten_byte_finals = b[ends[lengths == _MAX_BYTES]]
+    if ten_byte_finals.size and ten_byte_finals.max() > 1:
+        raise ValueError("varint overflows 64 bits")
+    pos = np.arange(b.size, dtype=np.uint64) - np.repeat(
+        starts.astype(np.uint64), lengths
+    )
+    contrib = (b & 0x7F).astype(np.uint64) << (np.uint64(7) * pos)
+    u = np.add.reduceat(contrib, starts)
+    return ((u >> np.uint64(1)).astype(np.int64)) ^ -(u & np.uint64(1)).astype(np.int64)

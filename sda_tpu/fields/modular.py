@@ -73,7 +73,7 @@ def modmatmul(a, b, p: int):
     """
     if p >= MAX_MODULUS:
         raise ValueError(f"modulus {p} >= 2^31 unsupported by limb modmatmul")
-    k = b.shape[0]
+    k = b.shape[-2] if b.ndim >= 2 else b.shape[0]  # contraction axis
     if k * p * p < (1 << 62):
         return _modmatmul_direct(a, b, p)
     if k >= (1 << 15):
@@ -88,6 +88,8 @@ def uniform_mod(key, shape, m: int):
     <= m / 2^64 (< 2^-33 for 31-bit moduli) — the TPU-native replacement for
     the reference's OsRng.gen_range (additive.rs:42-44, full.rs:25-27).
     """
+    if not 0 < m < (1 << 62):
+        raise ValueError(f"modulus {m} out of range for uniform_mod")
     bits = jax.random.bits(key, shape=shape + (2,), dtype=jnp.uint32)
     v = (bits[..., 0].astype(jnp.uint64) << jnp.uint64(32)) | bits[..., 1].astype(jnp.uint64)
     return jnp.mod(v, jnp.uint64(m)).astype(jnp.int64)
@@ -101,7 +103,7 @@ def np_modmatmul(a: np.ndarray, b: np.ndarray, p: int) -> np.ndarray:
         raise ValueError(f"modulus {p} >= 2^31 unsupported by limb modmatmul")
     a = np.asarray(a, dtype=np.int64)
     b = np.asarray(b, dtype=np.int64)
-    k = b.shape[0]
+    k = b.shape[-2] if b.ndim >= 2 else b.shape[0]  # contraction axis
     if k * p * p < (1 << 62):
         return np.matmul(a, b) % p
     if k >= (1 << 15):

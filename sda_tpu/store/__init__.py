@@ -1,0 +1,3 @@
+"""L6: client-side key/identity storage."""
+
+from .file import Filebased

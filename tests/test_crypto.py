@@ -1,0 +1,223 @@
+"""Tier-1 tests for the crypto layer: varint wire format, sealed boxes,
+signing, scheme-dispatched sharing/masking round-trips, keystores."""
+
+import numpy as np
+import pytest
+
+from sda_tpu.crypto import (
+    CryptoModule,
+    MemoryKeystore,
+    encryption,
+    masking,
+    sharing,
+    signing,
+    sodium,
+    varint,
+)
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Agent,
+    AgentId,
+    ChaChaMasking,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+    SodiumEncryption,
+)
+from sda_tpu.store import Filebased
+
+pytestmark = pytest.mark.skipif(
+    not sodium.available(), reason="libsodium not present"
+)
+
+GOLDEN_SHAMIR = PackedShamirSharing(3, 8, 4, 433, 354, 150)
+
+
+def make_agent(keystore):
+    crypto = CryptoModule(keystore)
+    return Agent(id=AgentId.random(), verification_key=crypto.new_verification_key()), crypto
+
+
+# ---------------------------------------------------------------------------
+# varint wire format
+
+def test_varint_roundtrip_edges():
+    vals = np.array(
+        [0, 1, -1, 2, -2, 63, 64, -64, -65, 127, 128, 300, -300,
+         2**31 - 1, -(2**31), 2**62, -(2**62), 2**63 - 1, -(2**63)],
+        dtype=np.int64,
+    )
+    enc = varint.encode(vals)
+    np.testing.assert_array_equal(varint.decode(enc), vals)
+
+
+def test_varint_zigzag_wire_bytes():
+    # zigzag: 0->0, -1->1, 1->2, -2->3; single-byte encodings
+    assert varint.encode(np.array([0], dtype=np.int64)) == b"\x00"
+    assert varint.encode(np.array([-1], dtype=np.int64)) == b"\x01"
+    assert varint.encode(np.array([1], dtype=np.int64)) == b"\x02"
+    # 64 -> zigzag 128 -> LEB128 0x80 0x01
+    assert varint.encode(np.array([64], dtype=np.int64)) == b"\x80\x01"
+
+
+def test_varint_bulk_random():
+    rng = np.random.default_rng(0)
+    vals = rng.integers(-(2**62), 2**62, size=100_000, dtype=np.int64)
+    np.testing.assert_array_equal(varint.decode(varint.encode(vals)), vals)
+
+
+def test_varint_malformed():
+    with pytest.raises(ValueError):
+        varint.decode(b"\x80")  # dangling continuation
+    with pytest.raises(ValueError):
+        varint.decode(b"\xff" * 9 + b"\x7f")  # 10th byte overflows u64
+    assert varint.decode(b"").shape == (0,)
+
+
+def test_randomness_modes():
+    from sda_tpu.crypto import rand
+
+    assert rand.get_mode() == "secure"  # OS-seeded ChaCha by default
+    a = rand.uniform((100,), 433)
+    assert a.min() >= 0 and a.max() < 433 and a.dtype == np.int64
+    b = rand.uniform((4, 25), 433, mode="fast")
+    assert b.shape == (4, 25) and b.min() >= 0 and b.max() < 433
+    with pytest.raises(ValueError):
+        rand.set_mode("bogus")
+
+
+# ---------------------------------------------------------------------------
+# sealed boxes + signing
+
+def test_sealedbox_roundtrip_and_tamper():
+    pk, sk = sodium.box_keypair()
+    msg = b"the shares"
+    boxed = sodium.seal(msg, pk)
+    assert sodium.seal_open(boxed, pk, sk) == msg
+    tampered = bytes([boxed[0] ^ 1]) + boxed[1:]
+    with pytest.raises(ValueError):
+        sodium.seal_open(tampered, pk, sk)
+    pk2, sk2 = sodium.box_keypair()
+    with pytest.raises(ValueError):
+        sodium.seal_open(boxed, pk2, sk2)  # wrong recipient
+
+
+def test_share_encryptor_decryptor():
+    ks = MemoryKeystore()
+    crypto = CryptoModule(ks)
+    key_id = crypto.new_encryption_key()
+    keypair = ks.get_encryption_keypair(key_id)
+    enc = crypto.new_share_encryptor(keypair.ek, SodiumEncryption())
+    dec = crypto.new_share_decryptor(key_id, SodiumEncryption())
+    shares = np.array([0, 1, 432, 5_000_000, 7], dtype=np.int64)
+    ct = enc.encrypt(shares)
+    assert ct.variant == "Sodium"
+    np.testing.assert_array_equal(dec.decrypt(ct), shares)
+
+
+def test_sign_export_and_verify():
+    ks = MemoryKeystore()
+    agent, crypto = make_agent(ks)
+    key_id = crypto.new_encryption_key()
+    signed = crypto.sign_export(agent, key_id)
+    assert signed is not None and signed.signer == agent.id
+    assert signing.signature_is_valid(agent, signed)
+    # tamper with the body -> invalid
+    from sda_tpu.protocol import B32, EncryptionKey, Labelled
+
+    tampered = type(signed)(
+        signature=signed.signature,
+        signer=signed.signer,
+        body=Labelled(signed.body.id, EncryptionKey("Sodium", B32(bytes(32)))),
+    )
+    assert not signing.signature_is_valid(agent, tampered)
+    # spoofed signer -> error
+    other, _ = make_agent(MemoryKeystore())
+    with pytest.raises(ValueError):
+        signing.signature_is_valid(other, signed)
+
+
+# ---------------------------------------------------------------------------
+# scheme-dispatched sharing
+
+@pytest.mark.parametrize("scheme", [AdditiveSharing(3, 433), GOLDEN_SHAMIR])
+def test_share_combine_reconstruct(scheme):
+    gen = sharing.new_share_generator(scheme)
+    comb = sharing.new_share_combiner(scheme)
+    secrets_a = [1, 2, 3, 4]
+    secrets_b = [1, 2, 3, 4]
+    shares_a = gen.generate(secrets_a)
+    shares_b = gen.generate(secrets_b)
+    assert len(shares_a) == scheme.output_size
+    combined = [comb.combine([sa, sb]) for sa, sb in zip(shares_a, shares_b)]
+    recon = sharing.new_secret_reconstructor(scheme, 4)
+    out = recon.reconstruct(list(enumerate(combined)))
+    np.testing.assert_array_equal(out % 433, [2, 4, 6, 8])
+
+
+def test_shamir_reconstruct_with_dropout():
+    gen = sharing.new_share_generator(GOLDEN_SHAMIR)
+    shares = gen.generate([7, 8, 9, 10, 11])
+    recon = sharing.new_secret_reconstructor(GOLDEN_SHAMIR, 5)
+    subset = [(i, shares[i]) for i in (7, 5, 4, 3, 2, 1, 0)]
+    np.testing.assert_array_equal(recon.reconstruct(subset), [7, 8, 9, 10, 11])
+
+
+# ---------------------------------------------------------------------------
+# masking
+
+@pytest.mark.parametrize(
+    "scheme",
+    [NoMasking(), FullMasking(433), ChaChaMasking(433, 6, 128)],
+)
+def test_masking_roundtrip(scheme):
+    masker = masking.new_secret_masker(scheme)
+    combiner = masking.new_mask_combiner(scheme)
+    unmasker = masking.new_secret_unmasker(scheme)
+    s1 = np.array([1, 2, 3, 4, 5, 6], dtype=np.int64)
+    s2 = np.array([10, 20, 30, 40, 50, 60], dtype=np.int64)
+    m1, x1 = masker.mask(s1)
+    m2, x2 = masker.mask(s2)
+    if scheme.has_mask:
+        assert not np.array_equal(x1, s1)  # masked secrets hide inputs
+    total_masked = (x1 + x2) % 433
+    total_mask = combiner.combine([m1, m2])
+    out = unmasker.unmask(total_mask, total_masked)
+    np.testing.assert_array_equal(out, (s1 + s2) % 433)
+
+
+def test_chacha_mask_is_seed_sized():
+    scheme = ChaChaMasking(433, 1000, 128)
+    masker = masking.new_secret_masker(scheme)
+    seed, masked = masker.mask(np.zeros(1000, dtype=np.int64))
+    assert seed.shape == (4,)  # 128 bits -> 4 u32 words, not O(d)
+    assert masked.shape == (1000,)
+
+
+# ---------------------------------------------------------------------------
+# file keystore
+
+def test_filebased_keystore_roundtrip(tmp_path):
+    ks = Filebased(tmp_path)
+    crypto = CryptoModule(ks)
+    key_id = crypto.new_encryption_key()
+    agent, _ = make_agent(ks)
+
+    ks2 = Filebased(tmp_path)  # reopen from disk
+    assert ks2.get_encryption_keypair(key_id) is not None
+    assert ks2.get_signature_keypair(agent.verification_key.id) is not None
+    assert ks2.get_encryption_keypair(type(key_id).random()) is None
+
+    ks.put_alias("agent", "some-id")
+    ks.put("some-id", {"hello": 1})
+    assert ks2.get_aliased("agent") == {"hello": 1}
+
+
+def test_crypto_module_with_file_keystore_encrypt(tmp_path):
+    ks = Filebased(tmp_path)
+    crypto = CryptoModule(ks)
+    key_id = crypto.new_encryption_key()
+    keypair = ks.get_encryption_keypair(key_id)
+    ct = crypto.new_share_encryptor(keypair.ek, SodiumEncryption()).encrypt([1, 2, 3])
+    out = crypto.new_share_decryptor(key_id, SodiumEncryption()).decrypt(ct)
+    np.testing.assert_array_equal(out, [1, 2, 3])

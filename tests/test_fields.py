@@ -170,6 +170,20 @@ def test_large_prime_limb_path():
     np.testing.assert_array_equal(np.asarray(got_dev), expect)
 
 
+def test_modmatmul_batched_contraction_axis():
+    """Regression: the overflow guard must size from the contraction axis
+    (b.shape[-2]), not the leading batch dim."""
+    p = 2**31 - 100
+    a = np.full((2, 8), p - 1, dtype=np.int64)
+    b = np.full((1, 8, 3), p - 1, dtype=np.int64)
+    expect = (8 * (p - 1) * (p - 1)) % p
+    np.testing.assert_array_equal(np_modmatmul(a, b, p), np.full((1, 2, 3), expect))
+    got = modmatmul(jnp.asarray(a), jnp.asarray(b), p)
+    np.testing.assert_array_equal(np.asarray(got), np.full((1, 2, 3), expect))
+    with pytest.raises(ValueError):
+        modmatmul(jnp.asarray(a), jnp.asarray(b), 1 << 31)  # modulus cap enforced
+
+
 def test_uniform_mod_range_and_determinism():
     key = jax.random.PRNGKey(11)
     draws = uniform_mod(key, (1000,), 433)
