@@ -1,0 +1,292 @@
+"""L2: the SDA client — participant / clerk / recipient workflows.
+
+``SdaClient`` (reference: client/src/lib.rs:39-56) binds an agent identity,
+a keystore-backed CryptoModule, and any ``SdaService`` implementation —
+in-process server, HTTP proxy, or the simulated-pod seam — and exposes the
+role workflows as methods (the reference splits them across the
+Participating/Clerking/Receiving/Maintenance traits).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..crypto import CryptoModule, Keystore, signature_is_valid
+from ..protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    ClerkingJob,
+    ClerkingResult,
+    Committee,
+    EncryptionKeyId,
+    NotFound,
+    Participation,
+    ParticipationId,
+    SdaService,
+    Snapshot,
+    SnapshotId,
+)
+
+
+class RecipientOutput:
+    """Revealed aggregate (receive.rs:7-21)."""
+
+    __slots__ = ("modulus", "values")
+
+    def __init__(self, modulus: int, values):
+        self.modulus = modulus
+        self.values = np.asarray(values, dtype=np.int64)
+
+    def positive(self) -> "RecipientOutput":
+        """Lift representatives into [0, modulus) — kept for API parity;
+        this implementation is canonical already (receive.rs:14-21)."""
+        return RecipientOutput(self.modulus, np.mod(self.values, self.modulus))
+
+    def __repr__(self):
+        return f"RecipientOutput(modulus={self.modulus}, values={self.values!r})"
+
+
+class SdaClient:
+    def __init__(self, agent: Agent, keystore: Keystore, service: SdaService):
+        self.agent = agent
+        self.crypto = CryptoModule(keystore)
+        self.service = service
+
+    @classmethod
+    def new_agent(cls, keystore: Keystore) -> Agent:
+        """Fresh agent with a signature keypair in the keystore
+        (profile.rs:10-18)."""
+        crypto = CryptoModule(keystore)
+        return Agent(id=AgentId.random(), verification_key=crypto.new_verification_key())
+
+    # ------------------------------------------------------------------
+    # Maintenance (profile.rs:21-51)
+
+    def upload_agent(self) -> None:
+        self.service.create_agent(self.agent, self.agent)
+
+    def new_encryption_key(self) -> EncryptionKeyId:
+        return self.crypto.new_encryption_key()
+
+    def upload_encryption_key(self, key: EncryptionKeyId) -> None:
+        signed = self.crypto.sign_export(self.agent, key)
+        if signed is None:
+            raise NotFound("could not sign encryption key")
+        self.service.create_encryption_key(self.agent, signed)
+
+    # ------------------------------------------------------------------
+    # Participating (participate.rs)
+
+    def participate(self, input: Sequence[int], aggregation: AggregationId) -> None:
+        """new_participation + upload in one go (participate.rs:31-35)."""
+        self.upload_participation(self.new_participation(input, aggregation))
+
+    def new_participation(
+        self, input: Sequence[int], aggregation_id: AggregationId
+    ) -> Participation:
+        """Mask -> share -> encrypt per clerk (participate.rs:37-113).
+
+        Separated from upload so a network failure can be retried without
+        recomputation or double participation (participate.rs:16-19).
+        """
+        secrets = np.asarray(input, dtype=np.int64)
+
+        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        if aggregation is None:
+            raise NotFound("could not find aggregation")
+        if secrets.shape != (aggregation.vector_dimension,):
+            raise ValueError("the input length does not match the aggregation")
+
+        committee = self.service.get_committee(self.agent, aggregation_id)
+        if committee is None:
+            raise NotFound("could not find committee")
+
+        # mask the secrets
+        masker = self.crypto.new_secret_masker(aggregation.masking_scheme)
+        recipient_mask, masked_secrets = masker.mask(secrets)
+
+        recipient_encryption = None
+        if len(recipient_mask) > 0:
+            recipient_key = self._fetch_verified_key(
+                aggregation.recipient, aggregation.recipient_key
+            )
+            encryptor = self.crypto.new_share_encryptor(
+                recipient_key, aggregation.recipient_encryption_scheme
+            )
+            recipient_encryption = encryptor.encrypt(recipient_mask)
+
+        # share the masked secrets; row i -> clerk i
+        generator = self.crypto.new_share_generator(aggregation.committee_sharing_scheme)
+        shares_per_clerk = generator.generate(masked_secrets)
+
+        clerk_encryptions = []
+        for (clerk_id, clerk_key_id), clerk_shares in zip(
+            committee.clerks_and_keys, shares_per_clerk
+        ):
+            clerk_key = self._fetch_verified_key(clerk_id, clerk_key_id)
+            encryptor = self.crypto.new_share_encryptor(
+                clerk_key, aggregation.committee_encryption_scheme
+            )
+            clerk_encryptions.append((clerk_id, encryptor.encrypt(clerk_shares)))
+
+        return Participation(
+            id=ParticipationId.random(),
+            participant=self.agent.id,
+            aggregation=aggregation.id,
+            recipient_encryption=recipient_encryption,
+            clerk_encryptions=clerk_encryptions,
+        )
+
+    def upload_participation(self, participation: Participation) -> None:
+        self.service.create_participation(self.agent, participation)
+
+    def _fetch_verified_key(self, owner_id: AgentId, key_id: EncryptionKeyId):
+        """Fetch an agent's signed encryption key and verify the signature
+        (participate.rs:58-71, 87-97)."""
+        signed_key = self.service.get_encryption_key(self.agent, key_id)
+        if signed_key is None:
+            raise NotFound("unknown encryption key")
+        owner = self.service.get_agent(self.agent, owner_id)
+        if owner is None:
+            raise NotFound("unknown agent")
+        if not signature_is_valid(owner, signed_key):
+            raise ValueError("signature verification failed for key")
+        return signed_key.body.body
+
+    # ------------------------------------------------------------------
+    # Clerking (clerk.rs)
+
+    def clerk_once(self) -> bool:
+        """Poll-process-upload one job; False when the queue is dry
+        (clerk.rs:25-37)."""
+        job = self.service.get_clerking_job(self.agent, self.agent.id)
+        if job is None:
+            return False
+        result = self.process_clerking_job(job)
+        self.service.create_clerking_result(self.agent, result)
+        return True
+
+    def run_chores(self, max_iterations: int = -1) -> None:
+        """Process jobs until dry (negative) or up to a bound (clerk.rs:39-57)."""
+        iterations = 0
+        while max_iterations < 0 or iterations < max_iterations:
+            if not self.clerk_once():
+                break
+            iterations += 1
+
+    def process_clerking_job(self, job: ClerkingJob) -> ClerkingResult:
+        """Decrypt shares -> modular sum -> re-encrypt to recipient
+        (clerk.rs:63-107) — the clerk hot path."""
+        aggregation = self.service.get_aggregation(self.agent, job.aggregation)
+        if aggregation is None:
+            raise NotFound("unknown aggregation")
+        committee = self.service.get_committee(self.agent, job.aggregation)
+        if committee is None:
+            raise NotFound("unknown committee")
+
+        own_key_id = next(
+            (key for (cid, key) in committee.clerks_and_keys if cid == self.agent.id),
+            None,
+        )
+        if own_key_id is None:
+            raise NotFound("could not find own encryption key in committee")
+
+        decryptor = self.crypto.new_share_decryptor(
+            own_key_id, aggregation.committee_encryption_scheme
+        )
+        share_vectors = [decryptor.decrypt(e) for e in job.encryptions]
+
+        combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
+        combined = combiner.combine(share_vectors)
+
+        recipient_key = self._fetch_verified_key(
+            aggregation.recipient, aggregation.recipient_key
+        )
+        encryptor = self.crypto.new_share_encryptor(
+            recipient_key, aggregation.recipient_encryption_scheme
+        )
+        return ClerkingResult(
+            job=job.id, clerk=job.clerk, encryption=encryptor.encrypt(combined)
+        )
+
+    # ------------------------------------------------------------------
+    # Receiving (receive.rs)
+
+    def upload_aggregation(self, aggregation: Aggregation) -> None:
+        self.service.create_aggregation(self.agent, aggregation)
+
+    def begin_aggregation(self, aggregation_id: AggregationId) -> None:
+        """Elect a committee from service suggestions (receive.rs:48-62)."""
+        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        if aggregation is None:
+            raise NotFound(f"unknown aggregation {aggregation_id}")
+        candidates = self.service.suggest_committee(self.agent, aggregation_id)
+        needed = aggregation.committee_sharing_scheme.output_size
+        selected = [(c.id, c.keys[0]) for c in candidates[:needed]]
+        self.service.create_committee(
+            self.agent, Committee(aggregation=aggregation_id, clerks_and_keys=selected)
+        )
+
+    def end_aggregation(self, aggregation_id: AggregationId) -> None:
+        """Close the round by creating a snapshot (receive.rs:64-78)."""
+        status = self.service.get_aggregation_status(self.agent, aggregation_id)
+        if status is None:
+            raise NotFound("unknown aggregation")
+        if len(status.snapshots) >= 1:
+            return
+        self.service.create_snapshot(
+            self.agent, Snapshot(id=SnapshotId.random(), aggregation=aggregation_id)
+        )
+
+    def reveal_aggregation(self, aggregation_id: AggregationId) -> RecipientOutput:
+        """Decrypt clerk results, reconstruct, combine+subtract masks
+        (receive.rs:80-157)."""
+        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        if aggregation is None:
+            raise NotFound(f"unknown aggregation {aggregation_id}")
+        committee = self.service.get_committee(self.agent, aggregation_id)
+        if committee is None:
+            raise NotFound(f"unknown committee {aggregation_id}")
+
+        status = self.service.get_aggregation_status(self.agent, aggregation_id)
+        if status is None:
+            raise NotFound("unknown aggregation")
+        snapshot = next((s for s in status.snapshots if s.result_ready), None)
+        if snapshot is None:
+            raise NotFound("aggregation not ready")
+        result = self.service.get_snapshot_result(self.agent, aggregation_id, snapshot.id)
+        if result is None:
+            raise NotFound("missing aggregation result")
+
+        decryptor = self.crypto.new_share_decryptor(
+            aggregation.recipient_key, aggregation.recipient_encryption_scheme
+        )
+
+        # combine masks (expanding seeds for ChaCha)
+        if result.recipient_encryptions is None:
+            mask = np.zeros(0, dtype=np.int64)
+        else:
+            decrypted = [decryptor.decrypt(e) for e in result.recipient_encryptions]
+            mask = self.crypto.new_mask_combiner(aggregation.masking_scheme).combine(decrypted)
+
+        # decrypt clerk results, map clerk id -> committee index
+        clerk_positions = {cid: ix for ix, (cid, _) in enumerate(committee.clerks_and_keys)}
+        indexed_shares = []
+        for clerking_result in result.clerk_encryptions:
+            ix = clerk_positions.get(clerking_result.clerk)
+            if ix is None:
+                raise NotFound(f"missing clerk {clerking_result.clerk}")
+            indexed_shares.append((ix, decryptor.decrypt(clerking_result.encryption)))
+
+        reconstructor = self.crypto.new_secret_reconstructor(
+            aggregation.committee_sharing_scheme, aggregation.vector_dimension
+        )
+        masked_output = reconstructor.reconstruct(indexed_shares)
+
+        unmasker = self.crypto.new_secret_unmasker(aggregation.masking_scheme)
+        output = unmasker.unmask(mask, masked_output)
+        return RecipientOutput(modulus=aggregation.modulus, values=output)

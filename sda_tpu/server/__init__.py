@@ -1,0 +1,54 @@
+"""L3/L4: server core, ACL service wrapper, snapshot scheduler, stores."""
+
+from __future__ import annotations
+
+from .core import SdaServer, SdaServerService
+from .memory import (
+    MemoryAgentsStore,
+    MemoryAggregationsStore,
+    MemoryAuthTokensStore,
+    MemoryClerkingJobsStore,
+)
+from .jsonfs import (
+    JsonAgentsStore,
+    JsonAggregationsStore,
+    JsonAuthTokensStore,
+    JsonClerkingJobsStore,
+)
+from .stores import (
+    AgentsStore,
+    AggregationsStore,
+    AuthToken,
+    AuthTokensStore,
+    BaseStore,
+    ClerkingJobsStore,
+    auth_token,
+)
+
+
+def new_memory_server() -> SdaServerService:
+    """Whole server in process memory — test/simulation fixture."""
+    return SdaServerService(
+        SdaServer(
+            agents_store=MemoryAgentsStore(),
+            auth_tokens_store=MemoryAuthTokensStore(),
+            aggregation_store=MemoryAggregationsStore(),
+            clerking_job_store=MemoryClerkingJobsStore(),
+        )
+    )
+
+
+def new_jsonfs_server(directory) -> SdaServerService:
+    """Durable JSON-file-backed server (reference: new_jfs_server,
+    server/src/lib.rs:34-45)."""
+    from pathlib import Path
+
+    root = Path(directory)
+    return SdaServerService(
+        SdaServer(
+            agents_store=JsonAgentsStore(root / "agents"),
+            auth_tokens_store=JsonAuthTokensStore(root / "auths"),
+            aggregation_store=JsonAggregationsStore(root / "agg"),
+            clerking_job_store=JsonClerkingJobsStore(root / "jobs"),
+        )
+    )

@@ -1,0 +1,311 @@
+"""Server core: raw operations + the ACL-enforcing service wrapper.
+
+Reference: server/src/server.rs. ``SdaServer`` is a thin delegation over the
+four store interfaces plus auth-token checking; ``SdaServerService`` is the
+``SdaService`` implementation that guards every mutating call with
+"caller is the owner" checks (acl_agent_is, :203-209) and recipient-only /
+clerk-only rules (:270-360). The server holds no in-memory protocol state —
+every object is durable in a store the moment it exists, which is the
+framework's checkpoint/resume story (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    AggregationStatus,
+    ClerkCandidate,
+    ClerkingJob,
+    ClerkingJobId,
+    ClerkingResult,
+    Committee,
+    EncryptionKeyId,
+    InvalidCredentials,
+    InvalidRequest,
+    NotFound,
+    Participation,
+    PermissionDenied,
+    Pong,
+    Profile,
+    SdaService,
+    Signed,
+    Snapshot,
+    SnapshotId,
+    SnapshotResult,
+    SnapshotStatus,
+)
+from . import snapshot as snapshot_mod
+from .stores import (
+    AgentsStore,
+    AggregationsStore,
+    AuthToken,
+    AuthTokensStore,
+    ClerkingJobsStore,
+)
+
+
+class SdaServer:
+    """Raw server operations over pluggable stores (server.rs:5-191)."""
+
+    def __init__(
+        self,
+        agents_store: AgentsStore,
+        auth_tokens_store: AuthTokensStore,
+        aggregation_store: AggregationsStore,
+        clerking_job_store: ClerkingJobsStore,
+    ):
+        self.agents_store = agents_store
+        self.auth_tokens_store = auth_tokens_store
+        self.aggregation_store = aggregation_store
+        self.clerking_job_store = clerking_job_store
+
+    # -- health ------------------------------------------------------------
+    def ping(self) -> Pong:
+        self.agents_store.ping()
+        return Pong(running=True)
+
+    # -- agents ------------------------------------------------------------
+    def create_agent(self, agent: Agent) -> None:
+        self.agents_store.create_agent(agent)
+
+    def get_agent(self, id: AgentId) -> Optional[Agent]:
+        return self.agents_store.get_agent(id)
+
+    def upsert_profile(self, profile: Profile) -> None:
+        self.agents_store.upsert_profile(profile)
+
+    def get_profile(self, agent: AgentId) -> Optional[Profile]:
+        return self.agents_store.get_profile(agent)
+
+    def create_encryption_key(self, key: Signed) -> None:
+        self.agents_store.create_encryption_key(key)
+
+    def get_encryption_key(self, key: EncryptionKeyId) -> Optional[Signed]:
+        return self.agents_store.get_encryption_key(key)
+
+    # -- aggregations ------------------------------------------------------
+    def list_aggregations(self, filter=None, recipient=None) -> List[AggregationId]:
+        return self.aggregation_store.list_aggregations(filter, recipient)
+
+    def get_aggregation(self, aggregation: AggregationId) -> Optional[Aggregation]:
+        return self.aggregation_store.get_aggregation(aggregation)
+
+    def get_committee(self, aggregation: AggregationId) -> Optional[Committee]:
+        return self.aggregation_store.get_committee(aggregation)
+
+    def create_aggregation(self, aggregation: Aggregation) -> None:
+        self.aggregation_store.create_aggregation(aggregation)
+
+    def delete_aggregation(self, aggregation: AggregationId) -> None:
+        self.aggregation_store.delete_aggregation(aggregation)
+
+    def suggest_committee(self, aggregation: AggregationId) -> List[ClerkCandidate]:
+        if self.aggregation_store.get_aggregation(aggregation) is None:
+            raise NotFound("aggregation not found")
+        return self.agents_store.suggest_committee()
+
+    def create_committee(self, committee: Committee) -> None:
+        agg = self.aggregation_store.get_aggregation(committee.aggregation)
+        if agg is None:
+            raise NotFound("aggregation not found")
+        expected = agg.committee_sharing_scheme.output_size
+        if expected != len(committee.clerks_and_keys):
+            raise InvalidRequest(
+                f"expected {expected} clerks in the committee, "
+                f"found {len(committee.clerks_and_keys)} instead"
+            )
+        self.aggregation_store.create_committee(committee)
+
+    # -- participation -----------------------------------------------------
+    def create_participation(self, participation: Participation) -> None:
+        self.aggregation_store.create_participation(participation)
+
+    # -- status / snapshots ------------------------------------------------
+    def get_aggregation_status(
+        self, aggregation: AggregationId
+    ) -> Optional[AggregationStatus]:
+        agg = self.aggregation_store.get_aggregation(aggregation)
+        if agg is None:
+            return None
+        threshold = agg.committee_sharing_scheme.reconstruction_threshold
+        snapshots = []
+        for sid in self.aggregation_store.list_snapshots(aggregation):
+            count = len(self.clerking_job_store.list_results(sid))
+            snapshots.append(
+                SnapshotStatus(
+                    id=sid,
+                    number_of_clerking_results=count,
+                    result_ready=count >= threshold,
+                )
+            )
+        return AggregationStatus(
+            aggregation=aggregation,
+            number_of_participations=self.aggregation_store.count_participations(aggregation),
+            snapshots=snapshots,
+        )
+
+    def create_snapshot(self, snapshot: Snapshot) -> None:
+        snapshot_mod.snapshot(self, snapshot)
+
+    # -- clerking ----------------------------------------------------------
+    def poll_clerking_job(self, clerk: AgentId) -> Optional[ClerkingJob]:
+        return self.clerking_job_store.poll_clerking_job(clerk)
+
+    def get_clerking_job(
+        self, clerk: AgentId, job: ClerkingJobId
+    ) -> Optional[ClerkingJob]:
+        return self.clerking_job_store.get_clerking_job(clerk, job)
+
+    def create_clerking_result(self, result: ClerkingResult) -> None:
+        self.clerking_job_store.create_clerking_result(result)
+
+    def get_snapshot_result(
+        self, aggregation: AggregationId, snapshot: SnapshotId
+    ) -> Optional[SnapshotResult]:
+        # the snapshot must exist under THIS aggregation — otherwise a caller
+        # could read another aggregation's snapshot artifacts by id
+        if self.aggregation_store.get_snapshot(aggregation, snapshot) is None:
+            return None
+        results = []
+        for job_id in self.clerking_job_store.list_results(snapshot):
+            result = self.clerking_job_store.get_result(snapshot, job_id)
+            if result is None:
+                raise NotFound("inconsistent storage")
+            results.append(result)
+        return SnapshotResult(
+            snapshot=snapshot,
+            number_of_participations=self.aggregation_store.count_participations_snapshot(
+                aggregation, snapshot
+            ),
+            clerk_encryptions=results,
+            recipient_encryptions=self.aggregation_store.get_snapshot_mask(snapshot),
+        )
+
+    # -- auth tokens (used by the HTTP layer) ------------------------------
+    def upsert_auth_token(self, token: AuthToken) -> None:
+        self.auth_tokens_store.upsert_auth_token(token)
+
+    def check_auth_token(self, token: AuthToken) -> Agent:
+        import hmac
+
+        stored = self.auth_tokens_store.get_auth_token(token.id)
+        if stored is not None and hmac.compare_digest(
+            stored.body.encode(), token.body.encode()
+        ):
+            agent = self.agents_store.get_agent(token.id)
+            if agent is None:
+                raise NotFound("agent not found")
+            return agent
+        raise InvalidCredentials()
+
+    def delete_auth_token(self, agent: AgentId) -> None:
+        self.auth_tokens_store.delete_auth_token(agent)
+
+
+def _acl_agent_is(caller: Agent, agent_id: AgentId) -> None:
+    """Every mutating call is guarded by caller identity (server.rs:203-209)."""
+    if caller.id != agent_id:
+        raise PermissionDenied()
+
+
+class SdaServerService(SdaService):
+    """ACL-enforcing SdaService over an SdaServer (server.rs:193-361)."""
+
+    def __init__(self, server: SdaServer):
+        self.server = server
+
+    def ping(self) -> Pong:
+        return self.server.ping()
+
+    # -- agent service -----------------------------------------------------
+    def create_agent(self, caller, agent):
+        _acl_agent_is(caller, agent.id)
+        self.server.create_agent(agent)
+
+    def get_agent(self, caller, agent):
+        return self.server.get_agent(agent)  # public, no acl
+
+    def upsert_profile(self, caller, profile):
+        _acl_agent_is(caller, profile.owner)
+        self.server.upsert_profile(profile)
+
+    def get_profile(self, caller, owner):
+        return self.server.get_profile(owner)  # public, no acl
+
+    def create_encryption_key(self, caller, key):
+        _acl_agent_is(caller, key.signer)
+        self.server.create_encryption_key(key)
+
+    def get_encryption_key(self, caller, key):
+        return self.server.get_encryption_key(key)  # public, no acl
+
+    # -- aggregation service -----------------------------------------------
+    def list_aggregations(self, caller, filter=None, recipient=None):
+        return self.server.list_aggregations(filter, recipient)
+
+    def get_aggregation(self, caller, aggregation):
+        return self.server.get_aggregation(aggregation)
+
+    def get_committee(self, caller, aggregation):
+        return self.server.get_committee(aggregation)
+
+    # -- recipient service -------------------------------------------------
+    def _recipient_only(self, caller: Agent, aggregation: AggregationId) -> Aggregation:
+        agg = self.server.get_aggregation(aggregation)
+        if agg is None:
+            raise NotFound("no aggregation found")
+        _acl_agent_is(caller, agg.recipient)
+        return agg
+
+    def create_aggregation(self, caller, aggregation):
+        _acl_agent_is(caller, aggregation.recipient)
+        self.server.create_aggregation(aggregation)
+
+    def delete_aggregation(self, caller, aggregation):
+        self._recipient_only(caller, aggregation)
+        self.server.delete_aggregation(aggregation)
+
+    def suggest_committee(self, caller, aggregation):
+        self._recipient_only(caller, aggregation)
+        return self.server.suggest_committee(aggregation)
+
+    def create_committee(self, caller, committee):
+        self._recipient_only(caller, committee.aggregation)
+        self.server.create_committee(committee)
+
+    def get_aggregation_status(self, caller, aggregation):
+        self._recipient_only(caller, aggregation)
+        return self.server.get_aggregation_status(aggregation)
+
+    def create_snapshot(self, caller, snapshot):
+        self._recipient_only(caller, snapshot.aggregation)
+        self.server.create_snapshot(snapshot)
+
+    def get_snapshot_result(self, caller, aggregation, snapshot):
+        self._recipient_only(caller, aggregation)
+        return self.server.get_snapshot_result(aggregation, snapshot)
+
+    # -- participation service ---------------------------------------------
+    def create_participation(self, caller, participation):
+        _acl_agent_is(caller, participation.participant)
+        self.server.create_participation(participation)
+
+    # -- clerking service --------------------------------------------------
+    def get_clerking_job(self, caller, clerk):
+        _acl_agent_is(caller, clerk)
+        return self.server.poll_clerking_job(clerk)
+
+    def create_clerking_result(self, caller, result):
+        # double-check the job really belongs to the caller — a spoofed
+        # result.clerk must not let one clerk overwrite another's work
+        # (server.rs:345-360)
+        job = self.server.get_clerking_job(result.clerk, result.job)
+        if job is None:
+            raise NotFound("job not found")
+        _acl_agent_is(caller, job.clerk)
+        self.server.create_clerking_result(result)

@@ -1,0 +1,322 @@
+"""JSON-file store backend: one file per protocol object, durable on write.
+
+The reference's jfs backend (server/src/jfs_stores/): every resource becomes
+a JSON file the moment it exists, so the server is crash-safe by
+construction — restart resumes from the directory tree. Layout:
+
+Each store class takes its own root; with ``new_jsonfs_server(root)`` the
+resulting tree is:
+
+    <root>/agents/agents/<agent-id>.json
+    <root>/agents/profiles/<agent-id>.json
+    <root>/agents/keys/<key-id>.json
+    <root>/auths/<agent-id>.json
+    <root>/agg/aggregations/<agg-id>.json
+    <root>/agg/committees/<agg-id>.json
+    <root>/agg/participations/<agg-id>/<participation-id>.json
+    <root>/agg/snapshots/<agg-id>/<snapshot-id>.json
+    <root>/agg/snapshot_parts/<snapshot-id>.json   (frozen participation ids)
+    <root>/agg/masks/<snapshot-id>.json
+    <root>/jobs/queue/<clerk-id>/<job-id>.json
+    <root>/jobs/done/<clerk-id>/<job-id>.json
+    <root>/jobs/results/<snapshot-id>/<job-id>.json
+
+The job queue mirrors the reference's per-clerk directory queue with
+queue -> done moves on result creation (jfs_stores/clerking_jobs.rs:36-59).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from pathlib import Path
+from typing import List, Optional
+
+from ..protocol import (
+    Agent,
+    Aggregation,
+    ClerkCandidate,
+    ClerkingJob,
+    ClerkingJobId,
+    ClerkingResult,
+    Committee,
+    Encryption,
+    NotFound,
+    Participation,
+    Profile,
+    Snapshot,
+    SnapshotId,
+    signed_encryption_key_from_obj,
+)
+from .stores import (
+    AgentsStore,
+    AggregationsStore,
+    AuthTokensStore,
+    BaseStore,
+    ClerkingJobsStore,
+    auth_token,
+)
+
+
+def _write_json(path: Path, obj) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _read_json(path: Path):
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def _ids_in(directory: Path) -> List[str]:
+    if not directory.is_dir():
+        return []
+    return sorted(p.stem for p in directory.glob("*.json") if not p.name.startswith("."))
+
+
+class _FsStore(BaseStore):
+    def __init__(self, root):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.RLock()
+
+    def ping(self) -> None:
+        if not self.root.is_dir():
+            raise NotFound(f"store root {self.root} missing")
+
+
+class JsonAuthTokensStore(_FsStore, AuthTokensStore):
+    def upsert_auth_token(self, token):
+        with self._lock:
+            _write_json(self.root / f"{token.id}.json", {"id": str(token.id), "body": token.body})
+
+    def get_auth_token(self, id):
+        with self._lock:
+            obj = _read_json(self.root / f"{id}.json")
+            return None if obj is None else auth_token(type(id)(obj["id"]), obj["body"])
+
+    def delete_auth_token(self, id):
+        with self._lock:
+            try:
+                (self.root / f"{id}.json").unlink()
+            except FileNotFoundError:
+                pass
+
+
+class JsonAgentsStore(_FsStore, AgentsStore):
+    def create_agent(self, agent):
+        with self._lock:
+            _write_json(self.root / "agents" / f"{agent.id}.json", agent.to_obj())
+
+    def get_agent(self, id):
+        with self._lock:
+            obj = _read_json(self.root / "agents" / f"{id}.json")
+            return None if obj is None else Agent.from_obj(obj)
+
+    def upsert_profile(self, profile):
+        with self._lock:
+            _write_json(self.root / "profiles" / f"{profile.owner}.json", profile.to_obj())
+
+    def get_profile(self, owner):
+        with self._lock:
+            obj = _read_json(self.root / "profiles" / f"{owner}.json")
+            return None if obj is None else Profile.from_obj(obj)
+
+    def create_encryption_key(self, key):
+        with self._lock:
+            _write_json(self.root / "keys" / f"{key.body.id}.json", key.to_obj())
+
+    def get_encryption_key(self, key):
+        with self._lock:
+            obj = _read_json(self.root / "keys" / f"{key}.json")
+            return None if obj is None else signed_encryption_key_from_obj(obj)
+
+    def suggest_committee(self):
+        with self._lock:
+            by_signer = {}
+            for key_id in _ids_in(self.root / "keys"):
+                signed = self.get_encryption_key(key_id)
+                by_signer.setdefault(signed.signer, []).append(signed.body.id)
+            return [
+                ClerkCandidate(id=signer, keys=keys)
+                for signer, keys in sorted(by_signer.items(), key=lambda kv: kv[0])
+            ]
+
+
+class JsonAggregationsStore(_FsStore, AggregationsStore):
+    def list_aggregations(self, filter=None, recipient=None):
+        with self._lock:
+            out = []
+            for agg_id in _ids_in(self.root / "aggregations"):
+                agg = self.get_aggregation(agg_id)
+                if filter is not None and filter not in agg.title:
+                    continue
+                if recipient is not None and agg.recipient != recipient:
+                    continue
+                out.append(agg.id)
+            return out
+
+    def create_aggregation(self, aggregation):
+        with self._lock:
+            _write_json(
+                self.root / "aggregations" / f"{aggregation.id}.json", aggregation.to_obj()
+            )
+
+    def get_aggregation(self, aggregation):
+        with self._lock:
+            obj = _read_json(self.root / "aggregations" / f"{aggregation}.json")
+            return None if obj is None else Aggregation.from_obj(obj)
+
+    def delete_aggregation(self, aggregation):
+        import shutil
+
+        with self._lock:
+            for sid in self.list_snapshots(aggregation):
+                (self.root / "snapshot_parts" / f"{sid}.json").unlink(missing_ok=True)
+                (self.root / "masks" / f"{sid}.json").unlink(missing_ok=True)
+            for sub in ("participations", "snapshots"):
+                shutil.rmtree(self.root / sub / str(aggregation), ignore_errors=True)
+            (self.root / "aggregations" / f"{aggregation}.json").unlink(missing_ok=True)
+            (self.root / "committees" / f"{aggregation}.json").unlink(missing_ok=True)
+
+    def get_committee(self, aggregation):
+        with self._lock:
+            obj = _read_json(self.root / "committees" / f"{aggregation}.json")
+            return None if obj is None else Committee.from_obj(obj)
+
+    def create_committee(self, committee):
+        with self._lock:
+            _write_json(
+                self.root / "committees" / f"{committee.aggregation}.json", committee.to_obj()
+            )
+
+    def create_participation(self, participation):
+        with self._lock:
+            if self.get_aggregation(participation.aggregation) is None:
+                raise NotFound("aggregation not found")
+            _write_json(
+                self.root / "participations" / str(participation.aggregation)
+                / f"{participation.id}.json",
+                participation.to_obj(),
+            )
+
+    def create_snapshot(self, snapshot):
+        with self._lock:
+            _write_json(
+                self.root / "snapshots" / str(snapshot.aggregation) / f"{snapshot.id}.json",
+                snapshot.to_obj(),
+            )
+
+    def list_snapshots(self, aggregation):
+        with self._lock:
+            return [
+                SnapshotId(s) for s in _ids_in(self.root / "snapshots" / str(aggregation))
+            ]
+
+    def get_snapshot(self, aggregation, snapshot):
+        with self._lock:
+            obj = _read_json(
+                self.root / "snapshots" / str(aggregation) / f"{snapshot}.json"
+            )
+            return None if obj is None else Snapshot.from_obj(obj)
+
+    def count_participations(self, aggregation):
+        with self._lock:
+            return len(_ids_in(self.root / "participations" / str(aggregation)))
+
+    def snapshot_participations(self, aggregation, snapshot):
+        with self._lock:
+            part_ids = _ids_in(self.root / "participations" / str(aggregation))
+            _write_json(self.root / "snapshot_parts" / f"{snapshot}.json", part_ids)
+
+    def count_participations_snapshot(self, aggregation, snapshot):
+        # the frozen id list already holds the answer — don't deserialize
+        # every participation just to count them
+        with self._lock:
+            part_ids = _read_json(self.root / "snapshot_parts" / f"{snapshot}.json") or []
+            return len(part_ids)
+
+    def iter_snapped_participations(self, aggregation, snapshot):
+        with self._lock:
+            part_ids = _read_json(self.root / "snapshot_parts" / f"{snapshot}.json") or []
+            out = []
+            for pid in part_ids:
+                obj = _read_json(
+                    self.root / "participations" / str(aggregation) / f"{pid}.json"
+                )
+                if obj is not None:
+                    out.append(Participation.from_obj(obj))
+            return out
+
+    def create_snapshot_mask(self, snapshot, mask):
+        with self._lock:
+            _write_json(
+                self.root / "masks" / f"{snapshot}.json", [e.to_obj() for e in mask]
+            )
+
+    def get_snapshot_mask(self, snapshot):
+        with self._lock:
+            obj = _read_json(self.root / "masks" / f"{snapshot}.json")
+            return None if obj is None else [Encryption.from_obj(e) for e in obj]
+
+
+class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
+    def enqueue_clerking_job(self, job):
+        with self._lock:
+            _write_json(
+                self.root / "queue" / str(job.clerk) / f"{job.id}.json", job.to_obj()
+            )
+
+    def poll_clerking_job(self, clerk):
+        with self._lock:
+            ids = _ids_in(self.root / "queue" / str(clerk))
+            if not ids:
+                return None
+            obj = _read_json(self.root / "queue" / str(clerk) / f"{ids[0]}.json")
+            return ClerkingJob.from_obj(obj)
+
+    def get_clerking_job(self, clerk, job):
+        with self._lock:
+            for sub in ("queue", "done"):
+                obj = _read_json(self.root / sub / str(clerk) / f"{job}.json")
+                if obj is not None:
+                    return ClerkingJob.from_obj(obj)
+            return None
+
+    def create_clerking_result(self, result):
+        with self._lock:
+            queue_path = self.root / "queue" / str(result.clerk) / f"{result.job}.json"
+            obj = _read_json(queue_path)
+            if obj is None:
+                if (self.root / "done" / str(result.clerk) / f"{result.job}.json").exists():
+                    return  # duplicate result upload: idempotent
+                raise NotFound("job not found for clerk")
+            job = ClerkingJob.from_obj(obj)
+            _write_json(
+                self.root / "results" / str(job.snapshot) / f"{result.job}.json",
+                result.to_obj(),
+            )
+            _write_json(self.root / "done" / str(result.clerk) / f"{job.id}.json", obj)
+            queue_path.unlink(missing_ok=True)
+
+    def list_results(self, snapshot):
+        with self._lock:
+            return [ClerkingJobId(i) for i in _ids_in(self.root / "results" / str(snapshot))]
+
+    def get_result(self, snapshot, job):
+        with self._lock:
+            obj = _read_json(self.root / "results" / str(snapshot) / f"{job}.json")
+            return None if obj is None else ClerkingResult.from_obj(obj)

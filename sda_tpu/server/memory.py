@@ -1,0 +1,242 @@
+"""In-memory store backend: dicts under a lock.
+
+The fastest fixture backend (the reference's analog is the jfs tempdir
+store used by integration tests); also the store of choice for
+simulated-pod runs where the server is pure control plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    ClerkCandidate,
+    ClerkingJob,
+    ClerkingJobId,
+    ClerkingResult,
+    Committee,
+    NotFound,
+    Participation,
+    ParticipationId,
+    Snapshot,
+    SnapshotId,
+)
+from .stores import (
+    AgentsStore,
+    AggregationsStore,
+    AuthTokensStore,
+    BaseStore,
+    ClerkingJobsStore,
+)
+
+
+class _Locked(BaseStore):
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def ping(self) -> None:
+        pass
+
+
+class MemoryAuthTokensStore(_Locked, AuthTokensStore):
+    def __init__(self):
+        super().__init__()
+        self._tokens = {}
+
+    def upsert_auth_token(self, token):
+        with self._lock:
+            self._tokens[token.id] = token
+
+    def get_auth_token(self, id):
+        with self._lock:
+            return self._tokens.get(id)
+
+    def delete_auth_token(self, id):
+        with self._lock:
+            self._tokens.pop(id, None)
+
+
+class MemoryAgentsStore(_Locked, AgentsStore):
+    def __init__(self):
+        super().__init__()
+        self._agents: Dict[AgentId, Agent] = {}
+        self._profiles = {}
+        self._keys = {}
+
+    def create_agent(self, agent):
+        with self._lock:
+            self._agents[agent.id] = agent
+
+    def get_agent(self, id):
+        with self._lock:
+            return self._agents.get(id)
+
+    def upsert_profile(self, profile):
+        with self._lock:
+            self._profiles[profile.owner] = profile
+
+    def get_profile(self, owner):
+        with self._lock:
+            return self._profiles.get(owner)
+
+    def create_encryption_key(self, key):
+        with self._lock:
+            self._keys[key.body.id] = key
+
+    def get_encryption_key(self, key):
+        with self._lock:
+            return self._keys.get(key)
+
+    def suggest_committee(self):
+        with self._lock:
+            by_signer: Dict[AgentId, List] = {}
+            for signed in self._keys.values():
+                by_signer.setdefault(signed.signer, []).append(signed.body.id)
+            return [
+                ClerkCandidate(id=signer, keys=keys)
+                for signer, keys in sorted(by_signer.items(), key=lambda kv: kv[0])
+            ]
+
+
+class MemoryAggregationsStore(_Locked, AggregationsStore):
+    def __init__(self):
+        super().__init__()
+        self._aggregations: Dict[AggregationId, Aggregation] = {}
+        self._committees: Dict[AggregationId, Committee] = {}
+        # insertion-ordered so snapshots freeze a deterministic set
+        self._participations: Dict[AggregationId, OrderedDict] = {}
+        self._snapshots: Dict[AggregationId, OrderedDict] = {}
+        self._snapshot_parts: Dict[SnapshotId, List[ParticipationId]] = {}
+        self._snapshot_masks = {}
+
+    def list_aggregations(self, filter=None, recipient=None):
+        with self._lock:
+            out = []
+            for agg in self._aggregations.values():
+                if filter is not None and filter not in agg.title:
+                    continue
+                if recipient is not None and agg.recipient != recipient:
+                    continue
+                out.append(agg.id)
+            return out
+
+    def create_aggregation(self, aggregation):
+        with self._lock:
+            self._aggregations[aggregation.id] = aggregation
+            self._participations.setdefault(aggregation.id, OrderedDict())
+            self._snapshots.setdefault(aggregation.id, OrderedDict())
+
+    def get_aggregation(self, aggregation):
+        with self._lock:
+            return self._aggregations.get(aggregation)
+
+    def delete_aggregation(self, aggregation):
+        with self._lock:
+            self._aggregations.pop(aggregation, None)
+            self._committees.pop(aggregation, None)
+            self._participations.pop(aggregation, None)
+            for sid in self._snapshots.pop(aggregation, OrderedDict()):
+                self._snapshot_parts.pop(sid, None)
+                self._snapshot_masks.pop(sid, None)
+
+    def get_committee(self, aggregation):
+        with self._lock:
+            return self._committees.get(aggregation)
+
+    def create_committee(self, committee):
+        with self._lock:
+            self._committees[committee.aggregation] = committee
+
+    def create_participation(self, participation):
+        with self._lock:
+            if participation.aggregation not in self._aggregations:
+                raise NotFound("aggregation not found")
+            # keyed by participation id: re-uploads (retries) are deduped
+            self._participations[participation.aggregation][participation.id] = participation
+
+    def create_snapshot(self, snapshot):
+        with self._lock:
+            self._snapshots[snapshot.aggregation][snapshot.id] = snapshot
+
+    def list_snapshots(self, aggregation):
+        with self._lock:
+            return list(self._snapshots.get(aggregation, OrderedDict()))
+
+    def get_snapshot(self, aggregation, snapshot):
+        with self._lock:
+            return self._snapshots.get(aggregation, OrderedDict()).get(snapshot)
+
+    def count_participations(self, aggregation):
+        with self._lock:
+            return len(self._participations.get(aggregation, OrderedDict()))
+
+    def snapshot_participations(self, aggregation, snapshot):
+        with self._lock:
+            self._snapshot_parts[snapshot] = list(
+                self._participations.get(aggregation, OrderedDict())
+            )
+
+    def iter_snapped_participations(self, aggregation, snapshot):
+        with self._lock:
+            part_ids = self._snapshot_parts.get(snapshot, [])
+            parts = self._participations.get(aggregation, OrderedDict())
+            return [parts[pid] for pid in part_ids if pid in parts]
+
+    def create_snapshot_mask(self, snapshot, mask):
+        with self._lock:
+            self._snapshot_masks[snapshot] = list(mask)
+
+    def get_snapshot_mask(self, snapshot):
+        with self._lock:
+            mask = self._snapshot_masks.get(snapshot)
+            return None if mask is None else list(mask)
+
+
+class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
+    def __init__(self):
+        super().__init__()
+        self._queues: Dict[AgentId, OrderedDict] = {}
+        self._done: Dict[AgentId, Dict[ClerkingJobId, ClerkingJob]] = {}
+        self._results: Dict[SnapshotId, OrderedDict] = {}
+
+    def enqueue_clerking_job(self, job):
+        with self._lock:
+            self._queues.setdefault(job.clerk, OrderedDict())[job.id] = job
+
+    def poll_clerking_job(self, clerk):
+        with self._lock:
+            queue = self._queues.get(clerk)
+            if not queue:
+                return None
+            return next(iter(queue.values()))
+
+    def get_clerking_job(self, clerk, job):
+        with self._lock:
+            found = self._queues.get(clerk, OrderedDict()).get(job)
+            if found is None:
+                found = self._done.get(clerk, {}).get(job)
+            return found
+
+    def create_clerking_result(self, result):
+        with self._lock:
+            queue = self._queues.get(result.clerk, OrderedDict())
+            job = queue.pop(result.job, None)
+            if job is None and result.job not in self._done.get(result.clerk, {}):
+                raise NotFound("job not found for clerk")
+            if job is not None:
+                self._done.setdefault(result.clerk, {})[job.id] = job
+                self._results.setdefault(job.snapshot, OrderedDict())[result.job] = result
+
+    def list_results(self, snapshot):
+        with self._lock:
+            return list(self._results.get(snapshot, OrderedDict()))
+
+    def get_result(self, snapshot, job):
+        with self._lock:
+            return self._results.get(snapshot, OrderedDict()).get(job)

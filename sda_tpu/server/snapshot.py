@@ -1,0 +1,60 @@
+"""The snapshot scheduler — the server-side "compute".
+
+Reference: server/src/snapshot.rs:4-47. Creating a snapshot (1) freezes the
+current participation set, (2) transposes participations x clerks into one
+ClerkingJob per committee member, (3) records the snapshot, and (4) collects
+the recipient-mask encryptions if the aggregation masks. All heavy lifting
+is data movement; the field math happens at the clerks.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..protocol import ClerkingJob, ClerkingJobId, NotFound, Snapshot
+
+log = logging.getLogger(__name__)
+
+
+def snapshot(server, snap: Snapshot) -> None:
+    aggregation = server.aggregation_store.get_aggregation(snap.aggregation)
+    if aggregation is None:
+        raise NotFound("lost aggregation")
+    log.debug("snapshot %s: freezing participations", snap.id)
+    server.aggregation_store.snapshot_participations(snap.aggregation, snap.id)
+
+    committee = server.get_committee(snap.aggregation)
+    if committee is None:
+        raise NotFound("lost committee")
+
+    log.debug("snapshot %s: transposing encryptions", snap.id)
+    columns = server.aggregation_store.iter_snapshot_clerk_jobs_data(
+        snap.aggregation, snap.id, len(committee.clerks_and_keys)
+    )
+
+    log.debug("snapshot %s: enqueueing %d clerking jobs", snap.id, len(columns))
+    for (clerk_id, _), encryptions in zip(committee.clerks_and_keys, columns):
+        server.clerking_job_store.enqueue_clerking_job(
+            ClerkingJob(
+                id=ClerkingJobId.random(),
+                clerk=clerk_id,
+                aggregation=snap.aggregation,
+                snapshot=snap.id,
+                encryptions=encryptions,
+            )
+        )
+
+    server.aggregation_store.create_snapshot(snap)
+
+    if aggregation.masking_scheme.has_mask:
+        log.debug("snapshot %s: collecting recipient mask encryptions", snap.id)
+        recipient_encryptions = []
+        for participation in server.aggregation_store.iter_snapped_participations(
+            snap.aggregation, snap.id
+        ):
+            if participation.recipient_encryption is None:
+                raise NotFound("participation should have had a recipient encryption")
+            recipient_encryptions.append(participation.recipient_encryption)
+        server.aggregation_store.create_snapshot_mask(snap.id, recipient_encryptions)
+
+    log.debug("snapshot %s: done", snap.id)

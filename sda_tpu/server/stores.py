@@ -1,0 +1,185 @@
+"""Storage interfaces for the server core.
+
+Mirrors reference: server/src/stores.rs — four store traits behind which the
+server is a thin delegation layer, so backends (memory, JSON-files, real
+databases) are swappable. The snapshot *transpose* — turning N participations
+x C clerks into C per-clerk job payloads — has a default implementation here
+(stores.rs:86-101), which concrete stores may override with something
+smarter (the reference's Mongo store uses an aggregation pipeline;
+server-store-mongodb/src/aggregations.rs:164-195).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, List, Optional, Tuple
+
+from ..protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    ClerkCandidate,
+    ClerkingJob,
+    ClerkingJobId,
+    ClerkingResult,
+    Committee,
+    Encryption,
+    EncryptionKeyId,
+    Labelled,
+    Participation,
+    Profile,
+    Signed,
+    Snapshot,
+    SnapshotId,
+)
+
+#: Auth token: an agent id labelled with its secret token string
+#: (stores.rs:7 ``AuthToken = Labelled<AgentId, String>``).
+AuthToken = Labelled
+
+
+def auth_token(id: AgentId, body: str) -> AuthToken:
+    return Labelled(id, body)
+
+
+class BaseStore(abc.ABC):
+    @abc.abstractmethod
+    def ping(self) -> None:
+        """Raise if the backend is unhealthy."""
+
+
+class AuthTokensStore(BaseStore):
+    @abc.abstractmethod
+    def upsert_auth_token(self, token: AuthToken) -> None: ...
+
+    @abc.abstractmethod
+    def get_auth_token(self, id: AgentId) -> Optional[AuthToken]: ...
+
+    @abc.abstractmethod
+    def delete_auth_token(self, id: AgentId) -> None: ...
+
+
+class AgentsStore(BaseStore):
+    @abc.abstractmethod
+    def create_agent(self, agent: Agent) -> None: ...
+
+    @abc.abstractmethod
+    def get_agent(self, id: AgentId) -> Optional[Agent]: ...
+
+    @abc.abstractmethod
+    def upsert_profile(self, profile: Profile) -> None: ...
+
+    @abc.abstractmethod
+    def get_profile(self, owner: AgentId) -> Optional[Profile]: ...
+
+    @abc.abstractmethod
+    def create_encryption_key(self, key: Signed) -> None: ...
+
+    @abc.abstractmethod
+    def get_encryption_key(self, key: EncryptionKeyId) -> Optional[Signed]: ...
+
+    @abc.abstractmethod
+    def suggest_committee(self) -> List[ClerkCandidate]:
+        """All agents owning encryption keys, sorted by agent id, with their
+        keys — the (temporary, like the reference's) committee heuristic
+        (jfs_stores/agents.rs:66-83)."""
+
+
+class AggregationsStore(BaseStore):
+    @abc.abstractmethod
+    def list_aggregations(
+        self, filter: Optional[str] = None, recipient: Optional[AgentId] = None
+    ) -> List[AggregationId]: ...
+
+    @abc.abstractmethod
+    def create_aggregation(self, aggregation: Aggregation) -> None: ...
+
+    @abc.abstractmethod
+    def get_aggregation(self, aggregation: AggregationId) -> Optional[Aggregation]: ...
+
+    @abc.abstractmethod
+    def delete_aggregation(self, aggregation: AggregationId) -> None: ...
+
+    @abc.abstractmethod
+    def get_committee(self, aggregation: AggregationId) -> Optional[Committee]: ...
+
+    @abc.abstractmethod
+    def create_committee(self, committee: Committee) -> None: ...
+
+    @abc.abstractmethod
+    def create_participation(self, participation: Participation) -> None: ...
+
+    @abc.abstractmethod
+    def create_snapshot(self, snapshot: Snapshot) -> None: ...
+
+    @abc.abstractmethod
+    def list_snapshots(self, aggregation: AggregationId) -> List[SnapshotId]: ...
+
+    @abc.abstractmethod
+    def get_snapshot(
+        self, aggregation: AggregationId, snapshot: SnapshotId
+    ) -> Optional[Snapshot]: ...
+
+    @abc.abstractmethod
+    def count_participations(self, aggregation: AggregationId) -> int: ...
+
+    @abc.abstractmethod
+    def snapshot_participations(
+        self, aggregation: AggregationId, snapshot: SnapshotId
+    ) -> None:
+        """Freeze the current participation set under the snapshot id — the
+        consistency point that keeps late arrivals out of a running round."""
+
+    @abc.abstractmethod
+    def iter_snapped_participations(
+        self, aggregation: AggregationId, snapshot: SnapshotId
+    ) -> Iterable[Participation]: ...
+
+    def count_participations_snapshot(
+        self, aggregation: AggregationId, snapshot: SnapshotId
+    ) -> int:
+        return sum(1 for _ in self.iter_snapped_participations(aggregation, snapshot))
+
+    def iter_snapshot_clerk_jobs_data(
+        self, aggregation: AggregationId, snapshot: SnapshotId, clerks_number: int
+    ) -> List[List[Encryption]]:
+        """THE server-side transpose (stores.rs:86-101): participation rows ->
+        per-clerk encryption columns, positionally by committee index."""
+        columns: List[List[Encryption]] = [[] for _ in range(clerks_number)]
+        for participation in self.iter_snapped_participations(aggregation, snapshot):
+            for ix, (_, encryption) in enumerate(participation.clerk_encryptions):
+                columns[ix].append(encryption)
+        return columns
+
+    @abc.abstractmethod
+    def create_snapshot_mask(
+        self, snapshot: SnapshotId, mask: List[Encryption]
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def get_snapshot_mask(self, snapshot: SnapshotId) -> Optional[List[Encryption]]: ...
+
+
+class ClerkingJobsStore(BaseStore):
+    @abc.abstractmethod
+    def enqueue_clerking_job(self, job: ClerkingJob) -> None: ...
+
+    @abc.abstractmethod
+    def poll_clerking_job(self, clerk: AgentId) -> Optional[ClerkingJob]: ...
+
+    @abc.abstractmethod
+    def get_clerking_job(
+        self, clerk: AgentId, job: ClerkingJobId
+    ) -> Optional[ClerkingJob]: ...
+
+    @abc.abstractmethod
+    def create_clerking_result(self, result: ClerkingResult) -> None: ...
+
+    @abc.abstractmethod
+    def list_results(self, snapshot: SnapshotId) -> List[ClerkingJobId]: ...
+
+    @abc.abstractmethod
+    def get_result(
+        self, snapshot: SnapshotId, job: ClerkingJobId
+    ) -> Optional[ClerkingResult]: ...

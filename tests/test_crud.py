@@ -1,0 +1,160 @@
+"""Tier-2: CRUD + ACL tests against the service seam (reference: crud.rs).
+
+Parametrized over memory and JSON-file backends — same tests, swapped
+fixture, per the reference's feature-gated test design.
+"""
+
+import pytest
+
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    EncryptionKeyId,
+    NoMasking,
+    NotFound,
+    PermissionDenied,
+    Profile,
+    InvalidCredentials,
+    SodiumEncryption,
+)
+from sda_tpu.server import auth_token, new_jsonfs_server, new_memory_server
+
+from util import new_agent, new_full_agent, new_key_for_agent
+
+
+@pytest.fixture(params=["memory", "jsonfs"])
+def service(request, tmp_path):
+    if request.param == "memory":
+        return new_memory_server()
+    return new_jsonfs_server(tmp_path)
+
+
+def default_aggregation(recipient, key) -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="foo",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.id,
+        recipient_key=key.body.id,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+
+
+def test_ping(service):
+    assert service.ping().running
+
+
+def test_agent_crud(service):
+    alice = new_agent()
+    service.create_agent(alice, alice)
+    assert service.get_agent(alice, alice.id) == alice
+    assert service.get_agent(alice, new_agent().id) is None
+
+
+def test_agent_create_spoof_denied(service):
+    alice, bob = new_agent(), new_agent()
+    with pytest.raises(PermissionDenied):
+        service.create_agent(alice, bob)
+
+
+def test_profile_upsert_and_spoof(service):
+    alice = new_agent()
+    service.create_agent(alice, alice)
+    profile = Profile(owner=alice.id, name="Alice")
+    service.upsert_profile(alice, profile)
+    assert service.get_profile(alice, alice.id).name == "Alice"
+    # update
+    service.upsert_profile(alice, Profile(owner=alice.id, name="Alice2"))
+    assert service.get_profile(alice, alice.id).name == "Alice2"
+    # spoof denied (crud.rs:63-81 semantics)
+    mallory = new_agent()
+    service.create_agent(mallory, mallory)
+    with pytest.raises(PermissionDenied):
+        service.upsert_profile(mallory, Profile(owner=alice.id, name="Evil"))
+
+
+def test_encryption_key_crud_and_spoof(service):
+    alice = new_agent()
+    service.create_agent(alice, alice)
+    key = new_key_for_agent(alice)
+    service.create_encryption_key(alice, key)
+    assert service.get_encryption_key(alice, key.body.id) == key
+    assert service.get_encryption_key(alice, EncryptionKeyId.random()) is None
+    mallory = new_agent()
+    with pytest.raises(PermissionDenied):
+        service.create_encryption_key(mallory, new_key_for_agent(alice))
+
+
+def test_aggregation_lifecycle_and_filters(service):
+    alice, alice_key = new_full_agent(service)
+    bob, bob_key = new_full_agent(service)
+
+    agg1 = default_aggregation(alice, alice_key).replace(title="apples and pears")
+    agg2 = default_aggregation(alice, alice_key).replace(title="apples only")
+    agg3 = default_aggregation(bob, bob_key).replace(title="only pears")
+    for caller, agg in [(alice, agg1), (alice, agg2), (bob, agg3)]:
+        service.create_aggregation(caller, agg)
+
+    ids = lambda l: {str(i) for i in l}
+    assert ids(service.list_aggregations(alice, filter="apples")) == ids([agg1.id, agg2.id])
+    assert ids(service.list_aggregations(alice, filter="pears")) == ids([agg1.id, agg3.id])
+    assert ids(service.list_aggregations(alice, recipient=bob.id)) == ids([agg3.id])
+    assert ids(
+        service.list_aggregations(alice, filter="pears", recipient=alice.id)
+    ) == ids([agg1.id])
+
+    # only the recipient can delete
+    with pytest.raises(PermissionDenied):
+        service.delete_aggregation(bob, agg1.id)
+    service.delete_aggregation(alice, agg1.id)
+    assert service.get_aggregation(alice, agg1.id) is None
+    with pytest.raises(NotFound):
+        service.delete_aggregation(alice, agg1.id)
+
+
+def test_aggregation_create_spoof_denied(service):
+    alice, alice_key = new_full_agent(service)
+    mallory = new_agent()
+    with pytest.raises(PermissionDenied):
+        service.create_aggregation(mallory, default_aggregation(alice, alice_key))
+
+
+def test_committee_size_validation(service):
+    alice, alice_key = new_full_agent(service)
+    agg = default_aggregation(alice, alice_key)  # share_count=3
+    service.create_aggregation(alice, agg)
+    from sda_tpu.protocol import Committee, InvalidRequest
+
+    too_small = Committee(aggregation=agg.id, clerks_and_keys=[(alice.id, alice_key.body.id)])
+    with pytest.raises(InvalidRequest):
+        service.create_committee(alice, too_small)
+
+
+def test_auth_token_lifecycle(service):
+    server = service.server
+    alice = new_agent()
+    service.create_agent(alice, alice)
+    token = auth_token(alice.id, "sekrit-token")
+    server.upsert_auth_token(token)
+    assert server.check_auth_token(token) == alice
+    with pytest.raises(InvalidCredentials):
+        server.check_auth_token(auth_token(alice.id, "wrong"))
+    server.delete_auth_token(alice.id)
+    with pytest.raises(InvalidCredentials):
+        server.check_auth_token(token)
+
+
+def test_status_requires_recipient(service):
+    alice, alice_key = new_full_agent(service)
+    bob, _ = new_full_agent(service)
+    agg = default_aggregation(alice, alice_key)
+    service.create_aggregation(alice, agg)
+    with pytest.raises(PermissionDenied):
+        service.get_aggregation_status(bob, agg.id)
+    status = service.get_aggregation_status(alice, agg.id)
+    assert status.number_of_participations == 0 and status.snapshots == []

@@ -1,0 +1,194 @@
+"""Tier-3: the golden end-to-end conformance suite.
+
+Mirrors the reference's full_loop.rs: real crypto, 1 recipient + 8 clerks +
+2 participants, full mask/share/clerk/reveal cycle asserting the exact sum
+[2, 4, 6, 8] over the four scheme configurations (full_loop.rs:29-67) —
+plain additive, Full mask, ChaCha mask, and PackedShamir(8 shares,
+threshold 4, p=433, omega=354/150). These four configs are the conformance
+bar for the TPU-native build.
+"""
+
+import numpy as np
+import pytest
+
+from sda_tpu.client import SdaClient
+from sda_tpu.crypto import MemoryKeystore, sodium
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    ChaChaMasking,
+    EncryptionKeyId,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+    SodiumEncryption,
+)
+from sda_tpu.server import new_jsonfs_server, new_memory_server
+from sda_tpu.store import Filebased
+
+pytestmark = pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+
+
+def agg_default() -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="foo",
+        vector_dimension=4,
+        modulus=433,
+        recipient=AgentId.random(),
+        recipient_key=EncryptionKeyId.random(),
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+
+
+def new_client(service, tmp_path=None):
+    keystore = MemoryKeystore() if tmp_path is None else Filebased(tmp_path)
+    agent = SdaClient.new_agent(keystore)
+    return SdaClient(agent, keystore, service)
+
+
+def check_full_aggregation(aggregation: Aggregation, service):
+    # prepare recipient
+    recipient = new_client(service)
+    recipient_key = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(recipient_key)
+
+    aggregation = aggregation.replace(
+        recipient=recipient.agent.id, recipient_key=recipient_key
+    )
+    recipient.upload_aggregation(aggregation)
+
+    # prepare clerks
+    clerks = [new_client(service) for _ in range(8)]
+    for clerk in clerks:
+        clerk_key = clerk.new_encryption_key()
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk_key)
+
+    # assign committee
+    recipient.begin_aggregation(aggregation.id)
+
+    # participate
+    participants = [new_client(service) for _ in range(2)]
+    for participant in participants:
+        participant.upload_agent()
+        participant.participate([1, 2, 3, 4], aggregation.id)
+
+    # close aggregation
+    recipient.end_aggregation(aggregation.id)
+
+    status = service.get_aggregation_status(recipient.agent, aggregation.id)
+    assert status.aggregation == aggregation.id
+    assert status.number_of_participations == len(participants)
+    assert len(status.snapshots) == 1
+    assert status.snapshots[0].number_of_clerking_results == 0
+    assert not status.snapshots[0].result_ready
+
+    # perform clerking — the recipient may be in the committee too, since it
+    # also registered an encryption key (full_loop.rs:131 runs its chores)
+    recipient.run_chores(-1)
+    for clerk in clerks:
+        clerk.run_chores(-1)
+
+    status = service.get_aggregation_status(recipient.agent, aggregation.id)
+    committee_size = aggregation.committee_sharing_scheme.output_size
+    assert status.snapshots[0].number_of_clerking_results == committee_size
+    assert status.snapshots[0].result_ready
+
+    # reveal
+    output = recipient.reveal_aggregation(aggregation.id)
+    np.testing.assert_array_equal(output.positive().values, [2, 4, 6, 8])
+
+
+@pytest.fixture(params=["memory", "jsonfs"])
+def service(request, tmp_path):
+    if request.param == "memory":
+        return new_memory_server()
+    return new_jsonfs_server(tmp_path)
+
+
+def test_simple(service):
+    check_full_aggregation(agg_default(), service)
+
+
+def test_with_fullmask(service):
+    check_full_aggregation(
+        agg_default().replace(masking_scheme=FullMasking(modulus=433)), service
+    )
+
+
+def test_with_chachamask(service):
+    check_full_aggregation(
+        agg_default().replace(
+            masking_scheme=ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128)
+        ),
+        service,
+    )
+
+
+def test_with_packedshamir(service):
+    check_full_aggregation(
+        agg_default().replace(
+            committee_sharing_scheme=PackedShamirSharing(
+                secret_count=3,
+                share_count=8,
+                privacy_threshold=4,
+                prime_modulus=433,
+                omega_secrets=354,
+                omega_shares=150,
+            )
+        ),
+        service,
+    )
+
+
+def test_packedshamir_with_clerk_dropout(service):
+    """Beyond the reference suite: reconstruction succeeds when one clerk
+    never does its job (fault tolerance, crypto.rs:146-153), exercising the
+    dynamic surviving-subset Lagrange path through the whole stack."""
+    aggregation = agg_default().replace(
+        committee_sharing_scheme=PackedShamirSharing(3, 8, 4, 433, 354, 150)
+    )
+    recipient = new_client(service)
+    recipient_key = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(recipient_key)
+    aggregation = aggregation.replace(
+        recipient=recipient.agent.id, recipient_key=recipient_key
+    )
+    recipient.upload_aggregation(aggregation)
+
+    clerks = [new_client(service) for _ in range(8)]
+    for clerk in clerks:
+        key = clerk.new_encryption_key()
+        clerk.upload_agent()
+        clerk.upload_encryption_key(key)
+    recipient.begin_aggregation(aggregation.id)
+
+    for _ in range(2):
+        p = new_client(service)
+        p.upload_agent()
+        p.participate([1, 2, 3, 4], aggregation.id)
+    recipient.end_aggregation(aggregation.id)
+
+    committee = service.get_committee(recipient.agent, aggregation.id)
+    committee_ids = {cid for cid, _ in committee.clerks_and_keys}
+    workers = [recipient] + clerks
+    dropped = next(w for w in workers if w.agent.id in committee_ids)
+    for worker in workers:
+        if worker is dropped:
+            continue  # one committee member goes dark
+        worker.run_chores(-1)
+
+    status = service.get_aggregation_status(recipient.agent, aggregation.id)
+    assert status.snapshots[0].number_of_clerking_results == 7  # of 8
+    assert status.snapshots[0].result_ready  # threshold is t+k = 7
+
+    output = recipient.reveal_aggregation(aggregation.id)
+    np.testing.assert_array_equal(output.positive().values, [2, 4, 6, 8])

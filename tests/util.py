@@ -1,0 +1,55 @@
+"""Shared test fixtures: fake-crypto agent factories and service contexts.
+
+Modeled on the reference harness (integration-tests/src/lib.rs): CRUD/logic
+tests use agents with all-zero keys and signatures (:51-71) since the server
+never verifies signatures; full-loop tests use real crypto via SdaClient.
+The fixture decides how distributed the system is — in-process memory,
+durable JSON files, or HTTP (the same tests run against each seam).
+"""
+
+from __future__ import annotations
+
+from sda_tpu.protocol import (
+    Agent,
+    AgentId,
+    B32,
+    B64,
+    Binary,
+    Encryption,
+    EncryptionKey,
+    EncryptionKeyId,
+    Labelled,
+    Signature,
+    Signed,
+    VerificationKey,
+    VerificationKeyId,
+)
+
+
+def new_agent() -> Agent:
+    return Agent(
+        id=AgentId.random(),
+        verification_key=Labelled(VerificationKeyId.random(), VerificationKey("Sodium", B32())),
+    )
+
+
+def new_key_for_agent(agent: Agent) -> Signed:
+    return Signed(
+        signature=Signature("Sodium", B64()),
+        signer=agent.id,
+        body=Labelled(EncryptionKeyId.random(), EncryptionKey("Sodium", B32())),
+    )
+
+
+def new_full_agent(service):
+    agent = new_agent()
+    service.create_agent(agent, agent)
+    key = new_key_for_agent(agent)
+    service.create_encryption_key(agent, key)
+    return agent, key
+
+
+def mock_encryption(data: bytes) -> Encryption:
+    """Raw bytes posing as a ciphertext — server logic never opens them
+    (reference mock pattern: integration-tests/tests/service.rs:29-47)."""
+    return Encryption("Sodium", Binary(data))
