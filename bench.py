@@ -1,0 +1,81 @@
+"""Flagship benchmark: secure-aggregation throughput on one chip.
+
+Config (BASELINE.json #2 scaled to a single chip): Packed-Shamir with an
+8-clerk committee over a ~30-bit NTT prime, 100 participants x ~1M-dim
+vectors, full masking. The timed region is the COMPLETE round — on-device
+mask+share randomness, share matmul, clerk combine, Lagrange reconstruction,
+unmask — i.e. every field operation the reference spreads across
+participant/clerk/recipient Rust loops.
+
+Metric: shared-elements/sec = participants x dimension / round-time (input
+elements pushed through the full pipeline). vs_baseline compares against
+the 1e9 north-star target (BASELINE.json; the reference publishes no
+numbers, BASELINE.md).
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sda_tpu.fields import numtheory
+    from sda_tpu.mesh import single_chip_round
+    from sda_tpu.protocol import FullMasking, PackedShamirSharing
+
+    participants = int(os.environ.get("SDA_BENCH_PARTICIPANTS", 100))
+    dim = int(os.environ.get("SDA_BENCH_DIM", 999_999))  # ~1M, divisible by 3
+
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 29)
+    scheme = PackedShamirSharing(3, 8, t, p, w2, w3)
+    fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
+
+    rng = np.random.default_rng(0)
+    inputs = jnp.asarray(
+        rng.integers(0, 1 << 20, size=(participants, dim), dtype=np.int64)
+    )
+    key = jax.random.PRNGKey(0)
+
+    # warmup / compile
+    out = fn(inputs, key)
+    out.block_until_ready()
+
+    reps = int(os.environ.get("SDA_BENCH_REPS", 3))
+    times = []
+    for i in range(reps):
+        k = jax.random.fold_in(key, i)
+        start = time.perf_counter()
+        fn(inputs, k).block_until_ready()
+        times.append(time.perf_counter() - start)
+    best = min(times)
+
+    # sanity: the round must aggregate correctly
+    check = np.asarray(fn(inputs, key))
+    expected = np.asarray(inputs).sum(axis=0) % p
+    assert np.array_equal(check, expected), "benchmark round produced wrong aggregate"
+
+    value = participants * dim / best
+    print(
+        json.dumps(
+            {
+                "metric": "secure-aggregated shared-elements/sec/chip "
+                "(Packed-Shamir n=8 t=%d p=%d, full mask, %d x %d)"
+                % (t, p, participants, dim),
+                "value": round(value),
+                "unit": "elements/sec",
+                "vs_baseline": round(value / 1e9, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""TPU-native simulated-pod execution over a device mesh."""
+
+from .simpod import SimulatedPod, default_mesh_shape, make_mesh, single_chip_round

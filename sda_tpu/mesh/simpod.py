@@ -1,0 +1,268 @@
+"""Simulated-pod mode: the clerk committee on a TPU device mesh.
+
+The TPU-native execution mode the reference cannot express: instead of
+participants HTTP-POSTing encrypted shares to a broker that transposes them
+into per-clerk jobs (server/src/snapshot.rs), the whole aggregation round
+runs as ONE jitted SPMD program over a `jax.sharding.Mesh`, with XLA
+collectives over ICI replacing every server round-trip.
+
+Mesh axes and their protocol meaning (SURVEY.md §2.4 mapping):
+
+- ``p`` — participant shards; the clerk committee also lives along this
+  axis (clerk c's combined share lands on device c // (n/p_shards)).
+- ``d`` — vector-dimension shards (the reference's analog of sequence/
+  tensor parallelism: batching layer chunks, §5.7).
+
+Dataflow per round, per (p, d) device:
+
+1. mask + share the local [P/p, d/d'] participant block (threefry per
+   participant, share matmul on the local dim chunk);
+2. sum local participants' shares — participant parallelism is a *local*
+   reduction;
+3. ``psum_scatter`` over ``p`` splits the clerk axis while summing across
+   participant shards — this one collective IS the snapshot transpose plus
+   every clerk's combine, riding ICI instead of the broker;
+4. ``all_gather`` over ``p`` hands the recipient all clerk rows; the
+   reconstruct matmul and unmask run dim-sharded.
+
+Trust model: this mode computes the same algebra with the same scheme
+parameters but no transport encryption (devices of one pod trust each
+other); the scheme enums already model pluggable encryption — the
+federated HTTP mode keeps sealed boxes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..fields import modular, numtheory, sharing
+from ..protocol import (
+    FullMasking,
+    LinearMaskingScheme,
+    NoMasking,
+    PackedShamirSharing,
+)
+
+
+def _check_mask_modulus(masking, scheme) -> None:
+    # the mask/unmask algebra only cancels when masking and sharing operate
+    # in the same group
+    if isinstance(masking, FullMasking) and masking.modulus != scheme.prime_modulus:
+        raise ValueError(
+            f"masking modulus {masking.modulus} != sharing prime "
+            f"{scheme.prime_modulus}: masks would not cancel"
+        )
+
+
+def make_mesh(p_shards: int, d_shards: int, devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = p_shards * d_shards
+    if devices.size < n:
+        raise ValueError(f"need {n} devices, have {devices.size}")
+    return Mesh(devices.reshape(-1)[:n].reshape(p_shards, d_shards), ("p", "d"))
+
+
+def default_mesh_shape(n_devices: int, share_count: int) -> Tuple[int, int]:
+    """Largest p axis that divides both the device count and the committee."""
+    p_shards = math.gcd(n_devices, share_count)
+    return p_shards, n_devices // p_shards
+
+
+class SimulatedPod:
+    """One secure-aggregation round as a single SPMD program.
+
+    Requires: committee size divisible by the ``p`` axis, participants
+    divisible by ``p``, dimension divisible by ``secret_count * d_shards``
+    (pad inputs to fit — zero participants/components aggregate as zero).
+    """
+
+    def __init__(
+        self,
+        sharing_scheme: PackedShamirSharing,
+        masking_scheme: Optional[LinearMaskingScheme] = None,
+        mesh: Optional[Mesh] = None,
+    ):
+        if not isinstance(sharing_scheme, PackedShamirSharing):
+            raise ValueError("SimulatedPod currently runs Packed-Shamir rounds")
+        self.scheme = sharing_scheme
+        self.masking = masking_scheme or NoMasking()
+        if not isinstance(self.masking, (NoMasking, FullMasking)):
+            raise ValueError("simulated-pod masking: None or Full (seed PRGs are host-side)")
+        _check_mask_modulus(self.masking, sharing_scheme)
+        if mesh is None:
+            p_shards, d_shards = default_mesh_shape(
+                len(jax.devices()), sharing_scheme.share_count
+            )
+            mesh = make_mesh(p_shards, d_shards)
+        self.mesh = mesh
+        p_shards = mesh.devices.shape[0]
+        if sharing_scheme.share_count % p_shards:
+            raise ValueError(
+                f"committee size {sharing_scheme.share_count} must be divisible "
+                f"by the p axis ({p_shards})"
+            )
+        s = sharing_scheme
+        self._M = jnp.asarray(numtheory.packed_share_matrix(
+            s.secret_count, s.share_count, s.privacy_threshold,
+            s.prime_modulus, s.omega_secrets, s.omega_shares,
+        ))
+        self._L = jnp.asarray(numtheory.packed_reconstruct_matrix(
+            s.secret_count, s.share_count, s.privacy_threshold,
+            s.prime_modulus, s.omega_secrets, s.omega_shares,
+            tuple(range(s.share_count)),
+        ))
+        self._step = None
+        self._step_shape = None
+
+    # ------------------------------------------------------------------
+    def _local_round(self, inputs, key):
+        """Per-device body under shard_map: inputs [P_loc, d_loc]."""
+        s = self.scheme
+        p = s.prime_modulus
+        mod = self.masking.modulus if isinstance(self.masking, FullMasking) else p
+        P_loc, d_loc = inputs.shape
+        pi = jax.lax.axis_index("p")
+        di = jax.lax.axis_index("d")
+        # distinct randomness per device block
+        key = jax.random.fold_in(jax.random.fold_in(key, pi), di)
+
+        if isinstance(self.masking, FullMasking):
+            mkey, skey = jax.random.split(key)
+            masks = modular.uniform_mod(mkey, (P_loc, d_loc), mod)
+            masked = modular.modadd(inputs, masks, mod)
+            local_mask_sum = modular.modsum(masks, mod, axis=0)        # [d_loc]
+        else:
+            skey = key
+            masked = modular.canon(inputs, p)  # kernels need residues in [0, p)
+            local_mask_sum = jnp.zeros((d_loc,), jnp.int64)
+
+        # share each local participant's dim chunk: [P_loc, n, B_loc]
+        B_loc = d_loc // s.secret_count
+        shares = sharing.packed_share(
+            skey, masked, self._M,
+            prime=p, secret_count=s.secret_count, privacy_threshold=s.privacy_threshold,
+        )
+
+        # participant parallelism -> local reduction
+        local_sum = modular.modsum(shares, p, axis=0)                  # [n, B_loc]
+
+        # snapshot transpose + clerk combine == one psum_scatter over ICI:
+        # clerk axis is split across 'p' while partial sums are combined
+        clerk_rows = jax.lax.psum_scatter(
+            local_sum, "p", scatter_dimension=0, tiled=True
+        )                                                              # [n/p, B_loc]
+        clerk_rows = jnp.mod(clerk_rows, p)
+
+        # recipient gathers all clerk rows (clerk -> recipient leg)
+        gathered = jax.lax.all_gather(
+            clerk_rows, "p", axis=0, tiled=True
+        )                                                              # [n, B_loc]
+
+        # reconstruct on the local dim chunk
+        masked_total = sharing.packed_reconstruct(
+            gathered, self._L, prime=p, dimension=d_loc
+        )                                                              # [d_loc]
+
+        # unmask: combine mask across participant shards
+        mask_total = jax.lax.psum(local_mask_sum, "p")
+        if isinstance(self.masking, FullMasking):
+            mask_total = jnp.mod(mask_total, mod)
+            out = modular.modsub(masked_total, mask_total, mod)
+        else:
+            out = masked_total
+        return out                                                     # [d_loc]
+
+    def _build(self, P_total: int, d_total: int):
+        s = self.scheme
+        p_shards, d_shards = self.mesh.devices.shape
+        if P_total % p_shards:
+            raise ValueError(f"participants {P_total} not divisible by p axis {p_shards}")
+        if d_total % (s.secret_count * d_shards):
+            raise ValueError(
+                f"dimension {d_total} must be divisible by secret_count*d_shards "
+                f"= {s.secret_count * d_shards}"
+            )
+        fn = jax.shard_map(
+            self._local_round,
+            mesh=self.mesh,
+            in_specs=(P("p", "d"), P()),
+            out_specs=P("d"),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def aggregate(self, inputs, key=None):
+        """[P, d] participant inputs -> [d] aggregate (one full round)."""
+        inputs = jnp.asarray(inputs, dtype=jnp.int64)
+        if key is None:
+            from ..crypto.core import fresh_prng_key
+
+            key = fresh_prng_key()
+        shape = tuple(inputs.shape)
+        if self._step is None or self._step_shape != shape:
+            self._step = self._build(*shape)
+            self._step_shape = shape
+        sharding = NamedSharding(self.mesh, P("p", "d"))
+        inputs = jax.device_put(inputs, sharding)
+        return self._step(inputs, key)
+
+    def aggregate_fn(self, P_total: int, d_total: int):
+        """The raw jitted SPMD round for benchmarking/compile checks."""
+        return self._build(P_total, d_total)
+
+
+def single_chip_round(
+    sharing_scheme: PackedShamirSharing,
+    masking_scheme: Optional[LinearMaskingScheme] = None,
+):
+    """Collective-free full aggregation round, jittable on one device.
+
+    Same algebra as SimulatedPod (mask -> share -> combine -> reconstruct ->
+    unmask) with the committee resident on a single chip — the flagship
+    single-chip "forward step" and the unit benchmark kernel.
+    """
+    s = sharing_scheme
+    masking = masking_scheme or NoMasking()
+    if not isinstance(masking, (NoMasking, FullMasking)):
+        raise ValueError("single_chip_round masking: None or Full")
+    _check_mask_modulus(masking, s)
+    p = s.prime_modulus
+    M = jnp.asarray(numtheory.packed_share_matrix(
+        s.secret_count, s.share_count, s.privacy_threshold,
+        p, s.omega_secrets, s.omega_shares,
+    ))
+    L = jnp.asarray(numtheory.packed_reconstruct_matrix(
+        s.secret_count, s.share_count, s.privacy_threshold,
+        p, s.omega_secrets, s.omega_shares, tuple(range(s.share_count)),
+    ))
+
+    def round_fn(inputs, key):
+        P_total, d = inputs.shape
+        if isinstance(masking, FullMasking):
+            mod = masking.modulus
+            mkey, skey = jax.random.split(key)
+            masks = modular.uniform_mod(mkey, (P_total, d), mod)
+            masked = modular.modadd(inputs, masks, mod)
+            mask_total = modular.modsum(masks, mod, axis=0)
+        else:
+            skey = key
+            masked = modular.canon(inputs, p)  # kernels need residues in [0, p)
+            mask_total = None
+        shares = sharing.packed_share(
+            skey, masked, M,
+            prime=p, secret_count=s.secret_count, privacy_threshold=s.privacy_threshold,
+        )                                                   # [P, n, B]
+        combined = modular.modsum(shares, p, axis=0)        # [n, B] clerk combine
+        masked_total = sharing.packed_reconstruct(combined, L, prime=p, dimension=d)
+        if mask_total is None:
+            return masked_total
+        return modular.modsub(masked_total, mask_total, masking.modulus)
+
+    return round_fn
