@@ -1,0 +1,1 @@
+"""L7: command-line interfaces (`sda` agent tool, `sdad` server daemon)."""

@@ -1,0 +1,215 @@
+"""`sda` — the agent command-line interface.
+
+Reference: cli/src/main.rs. Subcommands: ping; agent create/show; agent keys
+create; clerk (poll loop); aggregations create/list/begin/end/status/reveal/
+delete; participate. Identity (agent + keys + auth token) lives in a
+directory (``-i``), server selection via ``-s``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+from ..client import SdaClient
+from ..protocol import (
+    AdditiveSharing,
+    Agent,
+    Aggregation,
+    AggregationId,
+    ChaChaMasking,
+    EncryptionKeyId,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+    SodiumEncryption,
+)
+from ..store import Filebased
+
+AGENT_ALIAS = "agent"
+KEY_ALIAS = "primary-encryption-key"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="sda", description="SDA agent CLI")
+    parser.add_argument("-s", "--server", default="http://127.0.0.1:8888",
+                        help="server root URL")
+    parser.add_argument("-i", "--identity", default=".sda", help="identity directory")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("ping")
+
+    agent = sub.add_parser("agent").add_subparsers(dest="agent_command", required=True)
+    agent.add_parser("create")
+    agent.add_parser("show")
+    keys = agent.add_parser("keys").add_subparsers(dest="keys_command", required=True)
+    keys.add_parser("create")
+
+    clerk = sub.add_parser("clerk")
+    clerk.add_argument("--once", action="store_true", help="drain the queue once and exit")
+    clerk.add_argument("--interval", type=float, default=300.0,
+                       help="poll sleep seconds when looping (reference: 5 min)")
+
+    agg = sub.add_parser("aggregations").add_subparsers(dest="agg_command", required=True)
+    create = agg.add_parser("create")
+    create.add_argument("title")
+    create.add_argument("--dimension", type=int, required=True)
+    create.add_argument("--modulus", type=int, required=True)
+    create.add_argument("--mask", choices=["none", "full", "chacha"], default="none")
+    create.add_argument("--seed-bits", type=int, default=128)
+    create.add_argument("--sharing", choices=["add", "shamir"], default="add")
+    create.add_argument("--shares", type=int, default=3, help="committee size")
+    create.add_argument("--secrets-per-batch", type=int, default=3,
+                        help="packed secrets per polynomial (shamir)")
+    lst = agg.add_parser("list")
+    lst.add_argument("--filter", default=None)
+    for name in ("begin", "end", "status", "reveal", "delete", "show"):
+        p = agg.add_parser(name)
+        p.add_argument("aggregation")
+
+    part = sub.add_parser("participate")
+    part.add_argument("aggregation")
+    part.add_argument("values", nargs="+", type=int)
+
+    return parser
+
+
+def load_client(args) -> SdaClient:
+    from ..http import SdaHttpClient
+
+    store = Filebased(args.identity)
+    service = SdaHttpClient(args.server, store=store)
+    agent_obj = store.get_aliased(AGENT_ALIAS)
+    if agent_obj is None:
+        agent = SdaClient.new_agent(store)
+        store.put(f"agent-{agent.id}", agent.to_obj())
+        store.put_alias(AGENT_ALIAS, f"agent-{agent.id}")
+    else:
+        agent = Agent.from_obj(agent_obj)
+    return SdaClient(agent, store, service)
+
+
+def _primary_key(client: SdaClient, store: Filebased) -> EncryptionKeyId:
+    record = store.get_aliased(KEY_ALIAS)
+    if record is None:
+        raise SystemExit("no encryption key; run `sda agent keys create` first")
+    return EncryptionKeyId(record["id"])
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=[logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
+    )
+    client = load_client(args)
+    store: Filebased = client.crypto.keystore  # type: ignore[assignment]
+
+    if args.command == "ping":
+        pong = client.service.ping()
+        print(json.dumps({"running": pong.running}))
+        return 0
+
+    if args.command == "agent":
+        if args.agent_command == "create":
+            client.upload_agent()
+            print(str(client.agent.id))
+            return 0
+        if args.agent_command == "show":
+            print(json.dumps(client.agent.to_obj(), indent=2))
+            return 0
+        if args.agent_command == "keys":
+            client.upload_agent()  # idempotent; key upload needs the agent
+            key_id = client.new_encryption_key()
+            client.upload_encryption_key(key_id)
+            store.put(f"keymeta-{key_id}", {"id": str(key_id)})
+            store.put_alias(KEY_ALIAS, f"keymeta-{key_id}")
+            print(str(key_id))
+            return 0
+
+    if args.command == "clerk":
+        client.upload_agent()
+        if args.once:
+            client.run_chores(-1)
+            return 0
+        while True:  # reference daemon loop: cli/src/main.rs:194-206
+            client.run_chores(-1)
+            time.sleep(args.interval)
+
+    if args.command == "aggregations":
+        if args.agg_command == "create":
+            if args.mask == "none":
+                masking = NoMasking()
+            elif args.mask == "full":
+                masking = FullMasking(args.modulus)
+            else:
+                masking = ChaChaMasking(args.modulus, args.dimension, args.seed_bits)
+            if args.sharing == "add":
+                sharing = AdditiveSharing(share_count=args.shares, modulus=args.modulus)
+            else:
+                from ..fields import numtheory
+
+                k = args.secrets_per_batch
+                # Unless the NTT prime equals the aggregation modulus, sums of
+                # masked values must never wrap mod p — pick p with ~21 bits
+                # of headroom over the modulus (≈2M participants), capped by
+                # the 31-bit kernel limit.
+                min_bits = min(args.modulus.bit_length() + 21, 30)
+                t, p, w2, w3 = numtheory.generate_packed_params(
+                    k, args.shares, min_modulus_bits=min_bits
+                )
+                if args.modulus != p:
+                    print(f"note: sharing over NTT prime {p} (headroom over "
+                          f"modulus {args.modulus})", file=sys.stderr)
+                sharing = PackedShamirSharing(k, args.shares, t, p, w2, w3)
+            aggregation = Aggregation(
+                id=AggregationId.random(),
+                title=args.title,
+                vector_dimension=args.dimension,
+                modulus=args.modulus,
+                recipient=client.agent.id,
+                recipient_key=_primary_key(client, store),
+                masking_scheme=masking,
+                committee_sharing_scheme=sharing,
+                recipient_encryption_scheme=SodiumEncryption(),
+                committee_encryption_scheme=SodiumEncryption(),
+            )
+            client.upload_aggregation(aggregation)
+            print(str(aggregation.id))
+            return 0
+        if args.agg_command == "list":
+            for agg_id in client.service.list_aggregations(client.agent, filter=args.filter):
+                print(str(agg_id))
+            return 0
+        agg_id = AggregationId(args.aggregation)
+        if args.agg_command == "begin":
+            client.begin_aggregation(agg_id)
+            return 0
+        if args.agg_command == "end":
+            client.end_aggregation(agg_id)
+            return 0
+        if args.agg_command in ("status", "show"):
+            status = client.service.get_aggregation_status(client.agent, agg_id)
+            print(json.dumps(status.to_obj() if status else None, indent=2))
+            return 0
+        if args.agg_command == "reveal":
+            output = client.reveal_aggregation(agg_id).positive()
+            print(" ".join(str(v) for v in output.values.tolist()))
+            return 0
+        if args.agg_command == "delete":
+            client.service.delete_aggregation(client.agent, agg_id)
+            return 0
+
+    if args.command == "participate":
+        client.upload_agent()
+        client.participate(args.values, AggregationId(args.aggregation))
+        return 0
+
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

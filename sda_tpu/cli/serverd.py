@@ -1,0 +1,49 @@
+"""`sdad` — the server daemon CLI.
+
+Reference: server-cli (sdad --jfs|--mongo httpd, bind 127.0.0.1:8888).
+Backends here: durable JSON files (--jfs DIR) or in-memory (--memory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="sdad", description="SDA server daemon")
+    backend = parser.add_mutually_exclusive_group()
+    backend.add_argument("--jfs", metavar="DIR", help="JSON-file store root")
+    backend.add_argument("--memory", action="store_true", help="in-memory store")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    sub = parser.add_subparsers(dest="command", required=True)
+    httpd = sub.add_parser("httpd")
+    httpd.add_argument("--bind", default="127.0.0.1:8888")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=[logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
+    )
+    from ..http import SdaHttpServer
+    from ..server import new_jsonfs_server, new_memory_server
+
+    if args.memory:
+        service = new_memory_server()
+    else:
+        service = new_jsonfs_server(args.jfs or "./sdad-store")
+
+    server = SdaHttpServer(service, bind=args.bind)
+    print(f"sdad listening on {server.address}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

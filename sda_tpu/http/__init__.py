@@ -1,0 +1,4 @@
+"""L5: REST transport — server routes and the client-side service proxy."""
+
+from .client import SdaHttpClient
+from .server import SdaHttpServer

@@ -1,0 +1,208 @@
+"""REST client: an SdaService re-assembled over HTTP.
+
+Reference: client-http/src/client.rs — the proxy implements the same service
+interface the in-process server does, so SdaClient code is transport-blind.
+The ``caller`` argument is carried by HTTP Basic auth: username = agent id,
+password = a locally minted 32-char token persisted in the client store
+(client-http/src/tokenstore.rs:8-23). A 404 bearing ``X-Resource-Not-Found``
+maps to ``None``; a bare 404 is a routing error (client.rs:65-72).
+"""
+
+from __future__ import annotations
+
+import secrets as _secrets
+from typing import List, Optional
+
+import requests
+
+from ..protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    AggregationStatus,
+    ClerkCandidate,
+    ClerkingJob,
+    Committee,
+    InvalidCredentials,
+    InvalidRequest,
+    NotFound,
+    Participation,
+    PermissionDenied,
+    Pong,
+    SdaService,
+    ServerError,
+    SnapshotResult,
+    signed_encryption_key_from_obj,
+)
+
+TOKEN_ALIAS = "auth-token"
+
+
+def _load_or_mint_token(store, agent_id: AgentId) -> str:
+    """Persisted per-identity token, minted on first use (tokenstore.rs:8-23)."""
+    record = store.get(f"token-{agent_id}")
+    if record is not None:
+        return record["token"]
+    token = _secrets.token_urlsafe(24)[:32]
+    store.put(f"token-{agent_id}", {"token": token})
+    return token
+
+
+class SdaHttpClient(SdaService):
+    def __init__(self, base_url: str, store=None, token: Optional[str] = None):
+        self.base_url = base_url.rstrip("/")
+        self.store = store
+        self._fixed_token = token
+        self._tokens = {}  # per-caller cache; one proxy can serve many agents
+        self.session = requests.Session()
+
+    def _auth(self, caller: Agent):
+        if self._fixed_token is not None:
+            return (str(caller.id), self._fixed_token)
+        token = self._tokens.get(caller.id)
+        if token is None:
+            if self.store is None:
+                raise InvalidCredentials("no token store configured")
+            token = _load_or_mint_token(self.store, caller.id)
+            self._tokens[caller.id] = token
+        return (str(caller.id), token)
+
+    def _check(self, response: requests.Response):
+        if response.status_code in (200, 201):
+            return response
+        if response.status_code == 404:
+            if response.headers.get("X-Resource-Not-Found"):
+                return None
+            raise NotFound(f"no such route: {response.url}")
+        body = response.text[:200]
+        if response.status_code == 401:
+            raise InvalidCredentials(body)
+        if response.status_code == 403:
+            raise PermissionDenied(body)
+        if response.status_code == 400:
+            raise InvalidRequest(body)
+        raise ServerError(f"HTTP {response.status_code}: {body}")
+
+    def _get(self, caller: Agent, path: str, params=None):
+        return self._check(
+            self.session.get(
+                self.base_url + path, params=params, auth=self._auth(caller), timeout=60
+            )
+        )
+
+    def _post(self, caller: Agent, path: str, obj) -> None:
+        self._check(
+            self.session.post(
+                self.base_url + path, json=obj, auth=self._auth(caller), timeout=60
+            )
+        )
+
+    def _delete(self, caller: Agent, path: str) -> None:
+        self._check(
+            self.session.delete(self.base_url + path, auth=self._auth(caller), timeout=60)
+        )
+
+    @staticmethod
+    def _option(response, codec):
+        return None if response is None else codec(response.json())
+
+    # -- service implementation --------------------------------------------
+    def ping(self) -> Pong:
+        response = self.session.get(self.base_url + "/v1/ping", timeout=60)
+        self._check(response)
+        return Pong.from_obj(response.json())
+
+    def create_agent(self, caller, agent):
+        self._post(caller, "/v1/agents/me", agent.to_obj())
+
+    def get_agent(self, caller, agent):
+        return self._option(
+            self._get(caller, f"/v1/agents/{agent}"), Agent.from_obj
+        )
+
+    def upsert_profile(self, caller, profile):
+        self._post(caller, "/v1/agents/me/profile", profile.to_obj())
+
+    def get_profile(self, caller, owner):
+        from ..protocol import Profile
+
+        return self._option(
+            self._get(caller, f"/v1/agents/{owner}/profile"), Profile.from_obj
+        )
+
+    def create_encryption_key(self, caller, key):
+        self._post(caller, "/v1/agents/me/keys", key.to_obj())
+
+    def get_encryption_key(self, caller, key):
+        return self._option(
+            self._get(caller, f"/v1/agents/any/keys/{key}"),
+            signed_encryption_key_from_obj,
+        )
+
+    def list_aggregations(self, caller, filter=None, recipient=None) -> List[AggregationId]:
+        params = {}
+        if filter is not None:
+            params["title"] = filter
+        if recipient is not None:
+            params["recipient"] = str(recipient)
+        response = self._get(caller, "/v1/aggregations", params=params)
+        return [AggregationId(i) for i in response.json()]
+
+    def get_aggregation(self, caller, aggregation):
+        return self._option(
+            self._get(caller, f"/v1/aggregations/{aggregation}"), Aggregation.from_obj
+        )
+
+    def get_committee(self, caller, aggregation):
+        return self._option(
+            self._get(caller, f"/v1/aggregations/{aggregation}/committee"),
+            Committee.from_obj,
+        )
+
+    def create_aggregation(self, caller, aggregation):
+        self._post(caller, "/v1/aggregations", aggregation.to_obj())
+
+    def delete_aggregation(self, caller, aggregation):
+        self._delete(caller, f"/v1/aggregations/{aggregation}")
+
+    def suggest_committee(self, caller, aggregation):
+        response = self._get(
+            caller, f"/v1/aggregations/{aggregation}/committee/suggestions"
+        )
+        if response is None:
+            raise NotFound("no aggregation found")
+        return [ClerkCandidate.from_obj(c) for c in response.json()]
+
+    def create_committee(self, caller, committee):
+        self._post(caller, "/v1/aggregations/implied/committee", committee.to_obj())
+
+    def get_aggregation_status(self, caller, aggregation):
+        return self._option(
+            self._get(caller, f"/v1/aggregations/{aggregation}/status"),
+            AggregationStatus.from_obj,
+        )
+
+    def create_snapshot(self, caller, snapshot):
+        self._post(caller, "/v1/aggregations/implied/snapshot", snapshot.to_obj())
+
+    def get_snapshot_result(self, caller, aggregation, snapshot):
+        return self._option(
+            self._get(
+                caller, f"/v1/aggregations/{aggregation}/snapshots/{snapshot}/result"
+            ),
+            SnapshotResult.from_obj,
+        )
+
+    def create_participation(self, caller, participation):
+        self._post(caller, "/v1/aggregations/participations", participation.to_obj())
+
+    def get_clerking_job(self, caller, clerk):
+        return self._option(
+            self._get(caller, "/v1/aggregations/any/jobs"), ClerkingJob.from_obj
+        )
+
+    def create_clerking_result(self, caller, result):
+        self._post(
+            caller, f"/v1/aggregations/implied/jobs/{result.job}/result", result.to_obj()
+        )

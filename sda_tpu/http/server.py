@@ -1,0 +1,320 @@
+"""REST server: the SdaService exposed over HTTP/JSON.
+
+Route map mirrors the reference's endpoint scheme (server-http/src/lib.rs
+doc table :19-60):
+
+    GET    /v1/ping
+    POST   /v1/agents/me
+    GET    /v1/agents/{AgentId}
+    POST   /v1/agents/me/profile
+    GET    /v1/agents/{AgentId}/profile
+    POST   /v1/agents/me/keys
+    GET    /v1/agents/any/keys/{EncryptionKeyId}
+    POST   /v1/aggregations
+    GET    /v1/aggregations?title=&recipient=
+    GET    /v1/aggregations/{AggregationId}
+    DELETE /v1/aggregations/{AggregationId}
+    GET    /v1/aggregations/{AggregationId}/committee/suggestions
+    POST   /v1/aggregations/implied/committee
+    GET    /v1/aggregations/{AggregationId}/committee
+    POST   /v1/aggregations/participations
+    GET    /v1/aggregations/{AggregationId}/status
+    POST   /v1/aggregations/implied/snapshot
+    GET    /v1/aggregations/any/jobs
+    POST   /v1/aggregations/implied/jobs/{ClerkingJobId}/result
+    GET    /v1/aggregations/{AggregationId}/snapshots/{SnapshotId}/result
+
+Authentication is HTTP Basic: username = agent id, password = a
+client-minted token. The token presented on the agent-creation POST is
+recorded and must be reused on subsequent requests (lib.rs:192-201).
+Missing resources answer 404 with an ``X-Resource-Not-Found`` header so
+clients can distinguish a missing resource from a missing route
+(lib.rs:338-343); errors map to 401/403/400/500 (lib.rs:105-122).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    ClerkingJobId,
+    ClerkingResult,
+    Committee,
+    EncryptionKeyId,
+    InvalidCredentials,
+    InvalidRequest,
+    NotFound,
+    Participation,
+    PermissionDenied,
+    Profile,
+    SdaError,
+    Snapshot,
+    SnapshotId,
+    signed_encryption_key_from_obj,
+)
+from ..server import SdaServerService, auth_token
+
+log = logging.getLogger(__name__)
+
+_ID = r"[0-9a-fA-F-]{36}"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "sda-tpu"
+
+    # silence default stderr spam; route through logging instead
+    def log_message(self, fmt, *args):
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+    # -- helpers -----------------------------------------------------------
+    @property
+    def service(self) -> SdaServerService:
+        return self.server.sda_service  # type: ignore[attr-defined]
+
+    def _credentials(self) -> Optional[Tuple[AgentId, str]]:
+        header = self.headers.get("Authorization", "")
+        if not header.startswith("Basic "):
+            return None
+        try:
+            decoded = base64.b64decode(header[6:]).decode("utf-8")
+            agent_id, _, token = decoded.partition(":")
+            return AgentId(agent_id), token
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    def _authenticate(self) -> Agent:
+        creds = self._credentials()
+        if creds is None:
+            raise InvalidCredentials("missing Basic auth")
+        return self.service.server.check_auth_token(auth_token(*creds))
+
+    def _json_body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b""
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise InvalidRequest(f"malformed JSON body: {e}")
+
+    def _reply(self, status: int, obj=None, resource_not_found=False):
+        body = b"" if obj is None else json.dumps(obj).encode("utf-8")
+        self.send_response(status)
+        if resource_not_found:
+            self.send_header("X-Resource-Not-Found", "true")
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_option(self, obj):
+        if obj is None:
+            self._reply(404, {"error": "resource not found"}, resource_not_found=True)
+        else:
+            self._reply(200, obj.to_obj())
+
+    # -- dispatch ----------------------------------------------------------
+    def _route(self, method: str):
+        url = urlparse(self.path)
+        path = url.path.rstrip("/")
+        query = parse_qs(url.query)
+
+        def m(pattern):
+            return re.fullmatch(pattern, path)
+
+        try:
+            if method == "GET" and path == "/v1/ping":
+                return self._reply(200, self.service.ping().to_obj())
+
+            if method == "POST" and path == "/v1/agents/me":
+                return self._create_agent()
+
+            caller = self._authenticate()
+
+            if r := m(rf"/v1/agents/({_ID})/profile"):
+                if method == "GET":
+                    return self._reply_option(
+                        self.service.get_profile(caller, AgentId(r.group(1)))
+                    )
+            if method == "POST" and path == "/v1/agents/me/profile":
+                profile = Profile.from_obj(self._json_body())
+                self.service.upsert_profile(caller, profile)
+                return self._reply(200)
+            if r := m(rf"/v1/agents/any/keys/({_ID})"):
+                if method == "GET":
+                    return self._reply_option(
+                        self.service.get_encryption_key(
+                            caller, EncryptionKeyId(r.group(1))
+                        )
+                    )
+            if method == "POST" and path == "/v1/agents/me/keys":
+                key = signed_encryption_key_from_obj(self._json_body())
+                self.service.create_encryption_key(caller, key)
+                return self._reply(201)
+            if r := m(rf"/v1/agents/({_ID})"):
+                if method == "GET":
+                    return self._reply_option(
+                        self.service.get_agent(caller, AgentId(r.group(1)))
+                    )
+
+            if path == "/v1/aggregations" and method == "GET":
+                title = query.get("title", [None])[0]
+                recipient = query.get("recipient", [None])[0]
+                ids = self.service.list_aggregations(
+                    caller,
+                    filter=title,
+                    recipient=None if recipient is None else AgentId(recipient),
+                )
+                return self._reply(200, [str(i) for i in ids])
+            if path == "/v1/aggregations" and method == "POST":
+                agg = Aggregation.from_obj(self._json_body())
+                self.service.create_aggregation(caller, agg)
+                return self._reply(201)
+            if r := m(rf"/v1/aggregations/({_ID})/committee/suggestions"):
+                if method == "GET":
+                    candidates = self.service.suggest_committee(
+                        caller, AggregationId(r.group(1))
+                    )
+                    return self._reply(200, [c.to_obj() for c in candidates])
+            if path == "/v1/aggregations/implied/committee" and method == "POST":
+                committee = Committee.from_obj(self._json_body())
+                self.service.create_committee(caller, committee)
+                return self._reply(201)
+            if r := m(rf"/v1/aggregations/({_ID})/committee"):
+                if method == "GET":
+                    return self._reply_option(
+                        self.service.get_committee(caller, AggregationId(r.group(1)))
+                    )
+            if path == "/v1/aggregations/participations" and method == "POST":
+                participation = Participation.from_obj(self._json_body())
+                self.service.create_participation(caller, participation)
+                return self._reply(201)
+            if r := m(rf"/v1/aggregations/({_ID})/status"):
+                if method == "GET":
+                    return self._reply_option(
+                        self.service.get_aggregation_status(
+                            caller, AggregationId(r.group(1))
+                        )
+                    )
+            if path == "/v1/aggregations/implied/snapshot" and method == "POST":
+                snap = Snapshot.from_obj(self._json_body())
+                self.service.create_snapshot(caller, snap)
+                return self._reply(201)
+            if path == "/v1/aggregations/any/jobs" and method == "GET":
+                return self._reply_option(
+                    self.service.get_clerking_job(caller, caller.id)
+                )
+            if r := m(rf"/v1/aggregations/implied/jobs/({_ID})/result"):
+                if method == "POST":
+                    result = ClerkingResult.from_obj(self._json_body())
+                    if str(result.job) != r.group(1).lower():
+                        raise InvalidRequest("result job id does not match route")
+                    self.service.create_clerking_result(caller, result)
+                    return self._reply(201)
+            if r := m(rf"/v1/aggregations/({_ID})/snapshots/({_ID})/result"):
+                if method == "GET":
+                    return self._reply_option(
+                        self.service.get_snapshot_result(
+                            caller, AggregationId(r.group(1)), SnapshotId(r.group(2))
+                        )
+                    )
+            if r := m(rf"/v1/aggregations/({_ID})"):
+                if method == "GET":
+                    return self._reply_option(
+                        self.service.get_aggregation(caller, AggregationId(r.group(1)))
+                    )
+                if method == "DELETE":
+                    self.service.delete_aggregation(caller, AggregationId(r.group(1)))
+                    return self._reply(200)
+
+            return self._reply(404, {"error": "no such route"})
+
+        except InvalidCredentials as e:
+            return self._reply(401, {"error": str(e)})
+        except PermissionDenied as e:
+            return self._reply(403, {"error": str(e)})
+        except (InvalidRequest, ValueError, KeyError, TypeError) as e:
+            return self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+        except NotFound as e:
+            return self._reply(404, {"error": str(e)}, resource_not_found=True)
+        except SdaError as e:
+            log.exception("server error")
+            return self._reply(500, {"error": str(e)})
+        except Exception as e:  # don't kill the connection thread
+            log.exception("unexpected server error")
+            return self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _create_agent(self):
+        """Agent self-registration also records the presented token
+        (lib.rs:192-201)."""
+        creds = self._credentials()
+        if creds is None:
+            raise InvalidCredentials("agent creation requires Basic auth")
+        agent_id, token = creds
+        if not token:
+            raise InvalidCredentials("empty token")
+        agent = Agent.from_obj(self._json_body())
+        if agent.id != agent_id:
+            raise PermissionDenied("auth username must match agent id")
+        # record-or-verify the token before the ACL'd create
+        try:
+            known = self.service.server.check_auth_token(auth_token(agent_id, token))
+        except InvalidCredentials:
+            if self.service.server.auth_tokens_store.get_auth_token(agent_id) is not None:
+                raise  # token exists but differs: reject
+            known = None
+        if known is None:
+            self.service.server.upsert_auth_token(auth_token(agent_id, token))
+        self.service.create_agent(agent, agent)
+        return self._reply(201)
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+
+class SdaHttpServer:
+    """Threaded HTTP server wrapping an SdaServerService."""
+
+    def __init__(self, service: SdaServerService, bind: str = "127.0.0.1:8888"):
+        host, _, port = bind.partition(":")
+        self.httpd = ThreadingHTTPServer((host, int(port or 8888)), _Handler)
+        self.httpd.sda_service = service  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start_background(self) -> "SdaHttpServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self.httpd.serve_forever()
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.httpd.server_close()

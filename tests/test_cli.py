@@ -1,0 +1,94 @@
+"""Tier-3: the CLI walkthrough — the reference's shell example end-to-end.
+
+Mirrors docs/simple-cli-example.sh: one `sdad` server, a recipient + three
+clerks with keys, three keyless participants, additive 3-way sharing of
+10-dim mod-433 vectors, expected reveal ``0 2 2 4 4 6 6 8 8 10``.
+Runs the real argparse CLI against a live HTTP server.
+"""
+
+import pytest
+
+from sda_tpu.crypto import sodium
+from sda_tpu.http import SdaHttpServer
+from sda_tpu.server import new_jsonfs_server
+
+from sda_tpu.cli.main import main as sda_main
+
+pytestmark = pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+
+
+@pytest.fixture
+def httpd(tmp_path):
+    server = SdaHttpServer(new_jsonfs_server(tmp_path / "server"), bind="127.0.0.1:0")
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+def test_simple_cli_walkthrough(httpd, tmp_path, capsys):
+    url = httpd.address
+
+    def sda(identity, *args):
+        rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity), *args])
+        assert rc == 0
+        return capsys.readouterr().out.strip()
+
+    # recipient + three clerks, all with encryption keys
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "agent", "create")
+        sda(who, "agent", "keys", "create")
+
+    # participants don't need encryption keys
+    for who in ("part-1", "part-2", "part-3"):
+        sda(who, "agent", "create")
+
+    assert sda("recipient", "ping") == '{"running": true}'
+
+    agg_id = sda(
+        "recipient", "aggregations", "create", "aggro",
+        "--dimension", "10", "--modulus", "433", "--shares", "3",
+    )
+    sda("recipient", "aggregations", "begin", agg_id)
+
+    sda("part-1", "participate", agg_id, "0", "1", "2", "3", "4", "5", "6", "7", "8", "9")
+    sda("part-2", "participate", agg_id, "0", "0", "0", "0", "0", "0", "0", "0", "0", "0")
+    sda("part-3", "participate", agg_id, "0", "1", "0", "1", "0", "1", "0", "1", "0", "1")
+
+    sda("recipient", "aggregations", "end", agg_id)
+
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "clerk", "--once")
+
+    # the reference walkthrough's expected final reveal (README.md)
+    assert sda("recipient", "aggregations", "reveal", agg_id) == "0 2 2 4 4 6 6 8 8 10"
+
+    listed = sda("recipient", "aggregations", "list")
+    assert agg_id in listed
+
+
+def test_cli_shamir_aggregation(httpd, tmp_path, capsys):
+    url = httpd.address
+
+    def sda(identity, *args):
+        rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity), *args])
+        assert rc == 0
+        return capsys.readouterr().out.strip()
+
+    sda("recipient", "agent", "create")
+    sda("recipient", "agent", "keys", "create")
+    for i in range(8):
+        sda(f"clerk-{i}", "agent", "create")
+        sda(f"clerk-{i}", "agent", "keys", "create")
+    agg_id = sda(
+        "recipient", "aggregations", "create", "shamir-run",
+        "--dimension", "4", "--modulus", "433",
+        "--sharing", "shamir", "--shares", "8", "--mask", "chacha",
+    )
+    sda("recipient", "aggregations", "begin", agg_id)
+    sda("p", "participate", agg_id, "1", "2", "3", "4")
+    sda("q", "participate", agg_id, "1", "2", "3", "4")
+    sda("recipient", "aggregations", "end", agg_id)
+    for i in range(8):
+        sda(f"clerk-{i}", "clerk", "--once")
+    sda("recipient", "clerk", "--once")
+    assert sda("recipient", "aggregations", "reveal", agg_id) == "2 4 6 8"

@@ -106,11 +106,22 @@ def check_full_aggregation(aggregation: Aggregation, service):
     np.testing.assert_array_equal(output.positive().values, [2, 4, 6, 8])
 
 
-@pytest.fixture(params=["memory", "jsonfs"])
+@pytest.fixture(params=["memory", "jsonfs", "http"])
 def service(request, tmp_path):
     if request.param == "memory":
-        return new_memory_server()
-    return new_jsonfs_server(tmp_path)
+        yield new_memory_server()
+    elif request.param == "jsonfs":
+        yield new_jsonfs_server(tmp_path)
+    else:
+        # full REST stack in one process (reference: with_service fixture,
+        # integration-tests/src/lib.rs:147-178)
+        from sda_tpu.http import SdaHttpClient, SdaHttpServer
+
+        http_server = SdaHttpServer(new_memory_server(), bind="127.0.0.1:0")
+        http_server.start_background()
+        proxy = SdaHttpClient(http_server.address, store=Filebased(tmp_path / "tokens"))
+        yield proxy
+        http_server.shutdown()
 
 
 def test_simple(service):
