@@ -93,21 +93,36 @@ class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
         self.dimension = dimension
         self.seed_bitsize = seed_bitsize
 
+    def _expand(self, seed):
+        from .. import native
+
+        if native.available():
+            return native.chacha_expand_mask(seed, self.dimension, self.modulus)
+        return chacha.expand_mask(seed, self.dimension, self.modulus)
+
     def mask(self, secrets):
         secrets = np.asarray(secrets, dtype=np.int64)
         assert secrets.shape == (self.dimension,)
         seed = chacha.random_seed(self.seed_bitsize)
-        mask_vec = chacha.expand_mask(seed, self.dimension, self.modulus)
+        mask_vec = self._expand(seed)
         masked = (secrets + mask_vec) % self.modulus
         return np.asarray(seed, dtype=np.int64), masked
 
     def combine(self, seeds):
         """Re-expand every participant's seed — the recipient hot loop
-        (receive.rs:102-118 for the ChaCha case, chacha.rs:57-77)."""
+        (receive.rs:102-118 for the ChaCha case, chacha.rs:57-77); served by
+        the native C++ kernel when present."""
+        from .. import native
+
+        if len(seeds) == 0:
+            return np.zeros(self.dimension, dtype=np.int64)
+        stacked = np.stack([np.asarray(s, dtype=np.int64) for s in seeds])
+        if native.available():
+            return native.chacha_combine_masks(stacked, self.dimension, self.modulus)
         result = np.zeros(self.dimension, dtype=np.int64)
-        for seed in seeds:
+        for seed in stacked:
             expanded = chacha.expand_mask(
-                [int(w) for w in np.asarray(seed)], self.dimension, self.modulus
+                [int(w) for w in seed], self.dimension, self.modulus
             )
             result = (result + expanded) % self.modulus
         return result

@@ -1,0 +1,153 @@
+"""Native host kernels: build-on-demand C++ shared library via ctypes.
+
+``available()`` gates all use — every caller has a pure-Python/numpy
+fallback, so a missing compiler degrades performance, never correctness.
+The library is compiled once into the package directory and reused.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / "src" / "sda_native.cpp"
+_LIB_PATH = _HERE / "libsda_native.so"
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _compile() -> bool:
+    cmd = [
+        "g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+        str(_SRC), "-o", str(_LIB_PATH),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        try:
+            stale = not _LIB_PATH.exists() or (
+                _SRC.exists() and _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime
+            )
+        except OSError:
+            stale = not _LIB_PATH.exists()
+        if stale:
+            if not _SRC.exists() or not _compile():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            if lib.sda_native_abi_version() != _ABI_VERSION:
+                _build_failed = True
+                return None
+        except OSError:
+            _build_failed = True
+            return None
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.sda_modmatmul_i64.argtypes = [i64p, i64p, i64p] + [ctypes.c_int64] * 4
+        lib.sda_modsum_axis0.argtypes = [i64p, i64p] + [ctypes.c_int64] * 3
+        lib.sda_chacha_expand_mask.argtypes = [u32p] + [ctypes.c_int64] * 3 + [i64p]
+        lib.sda_chacha_combine_masks.argtypes = (
+            [i64p] + [ctypes.c_int64] * 4 + [i64p, i64p]
+        )
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def modmatmul(a: np.ndarray, b: np.ndarray, p: int) -> np.ndarray:
+    """Exact (a @ b) mod p in C++ (128-bit accumulation); p < 2^62."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    a = np.ascontiguousarray(a, dtype=np.int64)
+    b = np.ascontiguousarray(b, dtype=np.int64)
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError("shape mismatch")
+    out = np.empty((m, n), dtype=np.int64)
+    rc = lib.sda_modmatmul_i64(_i64(a), _i64(b), _i64(out), m, k, n, p)
+    if rc:
+        raise ValueError("sda_modmatmul_i64 failed")
+    return out
+
+
+def modsum_axis0(x: np.ndarray, m: int) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    x = np.ascontiguousarray(x, dtype=np.int64)
+    rows, n = x.shape
+    out = np.empty(n, dtype=np.int64)
+    rc = lib.sda_modsum_axis0(_i64(x), _i64(out), rows, n, m)
+    if rc:
+        raise ValueError("sda_modsum_axis0 failed")
+    return out
+
+
+def chacha_expand_mask(seed: Sequence[int], dim: int, modulus: int) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if not 0 < modulus < (1 << 62):  # same validation as the Python spec
+        raise ValueError("modulus out of range")
+    seed_arr = np.asarray(list(seed), dtype=np.uint32)
+    out = np.empty(dim, dtype=np.int64)
+    rc = lib.sda_chacha_expand_mask(
+        seed_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        seed_arr.size, dim, modulus, _i64(out),
+    )
+    if rc:
+        raise ValueError("sda_chacha_expand_mask failed")
+    return out
+
+
+def chacha_combine_masks(
+    seeds: np.ndarray, dim: int, modulus: int
+) -> np.ndarray:
+    """Sum of expanded masks for [n_seeds, seed_words] i64 seeds — the
+    recipient hot loop in one native call."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if not 0 < modulus < (1 << 62):  # same validation as the Python spec
+        raise ValueError("modulus out of range")
+    seeds = np.ascontiguousarray(seeds, dtype=np.int64)
+    n_seeds, seed_words = seeds.shape
+    scratch = np.empty(dim, dtype=np.int64)
+    out = np.empty(dim, dtype=np.int64)
+    rc = lib.sda_chacha_combine_masks(
+        _i64(seeds), n_seeds, seed_words, dim, modulus, _i64(scratch), _i64(out)
+    )
+    if rc:
+        raise ValueError("sda_chacha_combine_masks failed")
+    return out

@@ -1,0 +1,142 @@
+// sda-tpu native host kernels.
+//
+// The reference's native-performance surface is libsodium (C) and the
+// threshold-secret-sharing Rust crate; sda-tpu binds libsodium directly via
+// ctypes and re-owns the field math here: an exact C++ oracle for the modular
+// matmul kernels (independent of numpy/XLA, used for bit-exactness audits)
+// plus a fast ChaCha20 mask PRG implementing CHACHA_PRG_V1
+// (sda_tpu/fields/chacha.py) for the recipient's seed re-expansion hot loop
+// (reference: client/src/receive.rs:102-118, masking/chacha.rs:57-77).
+//
+// Build: g++ -O3 -shared -fPIC (see build.py). ABI: plain C, int64/uint32
+// buffers owned by the caller.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// (a[m,k] @ b[k,n]) mod p with exact 128-bit accumulation.
+// Entries must be canonical residues in [0, p); p < 2^62.
+// Returns 0 on success, nonzero on bad arguments.
+int sda_modmatmul_i64(const int64_t* a, const int64_t* b, int64_t* out,
+                      int64_t m, int64_t k, int64_t n, int64_t p) {
+    if (p <= 0 || m < 0 || k < 0 || n < 0) return 1;
+    for (int64_t i = 0; i < m; ++i) {
+        for (int64_t j = 0; j < n; ++j) {
+            unsigned __int128 acc = 0;
+            for (int64_t t = 0; t < k; ++t) {
+                acc += (unsigned __int128)(uint64_t)a[i * k + t] *
+                       (uint64_t)b[t * n + j];
+                // lazy reduction: fold down before the 128-bit accumulator
+                // can overflow (p^2 < 2^124, so at most 8 products fit)
+                if ((t & 7) == 7) acc %= (uint64_t)p;
+            }
+            out[i * n + j] = (int64_t)(acc % (uint64_t)p);
+        }
+    }
+    return 0;
+}
+
+// Elementwise sum mod m over the leading axis: x[rows, n] -> out[n].
+int sda_modsum_axis0(const int64_t* x, int64_t* out, int64_t rows, int64_t n,
+                     int64_t m) {
+    if (m <= 0 || rows < 0 || n < 0) return 1;
+    for (int64_t j = 0; j < n; ++j) out[j] = 0;
+    for (int64_t i = 0; i < rows; ++i) {
+        const int64_t* row = x + i * n;
+        for (int64_t j = 0; j < n; ++j) {
+            int64_t v = out[j] + row[j] % m;
+            out[j] = v >= m ? v - m : v;
+        }
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// ChaCha20 (CHACHA_PRG_V1): RFC-7539 constants, key = seed words 0..7
+// (zero-padded), block counter in word 12, words 13..15 zero, 20 rounds.
+
+static inline uint32_t rotl32(uint32_t x, int n) {
+    return (x << n) | (x >> (32 - n));
+}
+
+#define SDA_QR(a, b, c, d)                                                   \
+    a += b; d ^= a; d = rotl32(d, 16);                                       \
+    c += d; b ^= c; b = rotl32(b, 12);                                       \
+    a += b; d ^= a; d = rotl32(d, 8);                                        \
+    c += d; b ^= c; b = rotl32(b, 7);
+
+static void chacha_block(const uint32_t key[8], uint32_t counter,
+                         uint32_t out[16]) {
+    uint32_t s[16] = {0x61707865u, 0x3320646Eu, 0x79622D32u, 0x6B206574u,
+                      key[0], key[1], key[2], key[3],
+                      key[4], key[5], key[6], key[7],
+                      counter, 0u, 0u, 0u};
+    uint32_t x[16];
+    std::memcpy(x, s, sizeof(x));
+    for (int i = 0; i < 10; ++i) {
+        SDA_QR(x[0], x[4], x[8], x[12]);
+        SDA_QR(x[1], x[5], x[9], x[13]);
+        SDA_QR(x[2], x[6], x[10], x[14]);
+        SDA_QR(x[3], x[7], x[11], x[15]);
+        SDA_QR(x[0], x[5], x[10], x[15]);
+        SDA_QR(x[1], x[6], x[11], x[12]);
+        SDA_QR(x[2], x[7], x[8], x[13]);
+        SDA_QR(x[3], x[4], x[9], x[14]);
+    }
+    for (int i = 0; i < 16; ++i) out[i] = x[i] + s[i];
+}
+
+// Expand a seed into `dim` uniform draws in [0, modulus) by rejection
+// sampling over u64 lanes (two keystream words each, low word first) —
+// bit-identical to sda_tpu.fields.chacha.expand_mask.
+int sda_chacha_expand_mask(const uint32_t* seed, int64_t seed_words,
+                           int64_t dim, int64_t modulus, int64_t* out) {
+    if (modulus <= 0 || dim < 0 || seed_words < 0 || seed_words > 8) return 1;
+    uint32_t key[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    for (int64_t i = 0; i < seed_words; ++i) key[i] = seed[i];
+    const uint64_t m = (uint64_t)modulus;
+    // accept v <= zone where zone+1 is the largest multiple of m <= 2^64
+    const uint64_t zone =
+        (uint64_t)(((((unsigned __int128)1) << 64) / m) * m - 1);
+    uint32_t counter = 0;
+    int64_t filled = 0;
+    uint32_t words[16];
+    while (filled < dim) {
+        chacha_block(key, counter++, words);
+        for (int lane = 0; lane < 8 && filled < dim; ++lane) {
+            uint64_t lo = words[2 * lane];
+            uint64_t hi = words[2 * lane + 1];
+            uint64_t v = (hi << 32) | lo;
+            if (v <= zone) out[filled++] = (int64_t)(v % m);
+        }
+    }
+    return 0;
+}
+
+// Sum of many expanded masks mod m — the recipient hot loop in one call:
+// seeds[n_seeds, seed_words] (as i64 per wire convention) -> out[dim].
+int sda_chacha_combine_masks(const int64_t* seeds, int64_t n_seeds,
+                             int64_t seed_words, int64_t dim, int64_t modulus,
+                             int64_t* scratch, int64_t* out) {
+    if (modulus <= 0) return 1;
+    for (int64_t j = 0; j < dim; ++j) out[j] = 0;
+    uint32_t seed32[8];
+    for (int64_t s = 0; s < n_seeds; ++s) {
+        if (seed_words > 8) return 1;
+        for (int64_t w = 0; w < seed_words; ++w)
+            seed32[w] = (uint32_t)(uint64_t)seeds[s * seed_words + w];
+        int rc = sda_chacha_expand_mask(seed32, seed_words, dim, modulus, scratch);
+        if (rc) return rc;
+        for (int64_t j = 0; j < dim; ++j) {
+            int64_t v = out[j] + scratch[j];
+            out[j] = v >= modulus ? v - modulus : v;
+        }
+    }
+    return 0;
+}
+
+int sda_native_abi_version() { return 1; }
+
+}  // extern "C"
