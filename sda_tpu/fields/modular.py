@@ -1,15 +1,17 @@
 """Device-side modular arithmetic kernels (jnp, jit-friendly).
 
 All arrays carry int64 values in canonical form [0, m). On TPU int64 is
-emulated in int32 pairs, so kernels are written to (a) keep intermediates
-small enough for exactness, and (b) expose an int8-limb MXU path for the
-hot matmul (``modmatmul``), which lowers to native int8 systolic-array
-matmuls with int32 accumulation.
+emulated in int32 pairs and — crucially — XLA cannot lower an s64
+``dot_general`` at all (the X64 rewrite is unimplemented for dot), so the
+hot matmul (``modmatmul``) is formulated dot-free: a broadcast multiply +
+reduction over the (always tiny: committee-sized) contraction axis, with
+the modular reduction applied every ``group`` terms so emulated-s64
+intermediates never overflow. XLA fuses the broadcast product into the
+reduction, so the big operand streams from HBM once.
 
-Overflow discipline (p < 2^31 enforced by schemes):
-- direct einsum path: products < p^2 < 2^62, safe only when k*p^2 < 2^63;
-- limb path: b split as b_hi*2^16 + b_lo, products < p*2^16 < 2^47, safe
-  for contraction sizes k < 2^15.
+Overflow discipline (p < 2^31 enforced by schemes): products < p^2 < 2^62;
+``group = (2^63 - 1) // p^2 >= 2`` terms are accumulated between
+reductions, so partial sums stay < 2^63.
 
 The reference computes the same algebra as scalar Rust loops over Vec<i64>
 (client/src/crypto/sharing/*.rs); the canonical-form convention here differs
@@ -47,20 +49,8 @@ def modsum(x, m, axis=0):
     return jnp.mod(jnp.sum(x, axis=axis, dtype=jnp.int64), m)
 
 
-def _modmatmul_direct(a, b, p):
-    return jnp.mod(jnp.matmul(a, b, preferred_element_type=jnp.int64), p)
-
-
-def _modmatmul_limb(a, b, p):
-    b_hi = b >> 16
-    b_lo = b & 0xFFFF
-    hi = jnp.matmul(a, b_hi, preferred_element_type=jnp.int64)
-    lo = jnp.matmul(a, b_lo, preferred_element_type=jnp.int64)
-    return jnp.mod(jnp.mod(hi, p) * ((1 << 16) % p) + jnp.mod(lo, p), p)
-
-
-#: Largest supported modulus (exclusive): residues must fit 31 bits so the
-#: 16-bit limb split keeps every int64 intermediate exact.
+#: Largest supported modulus (exclusive): residues must fit 31 bits so
+#: products fit s64 and at least two terms accumulate between reductions.
 MAX_MODULUS = 1 << 31
 
 
@@ -68,17 +58,43 @@ def modmatmul(a, b, p: int):
     """(a @ b) mod p for canonical int64 operands; p < 2^31.
 
     ``a`` is typically a small host-built scheme matrix ([n, m2] share or
-    [k, r] reconstruct matrix), ``b`` the batch-column data [m2, B] with B
-    huge — the MXU-shaped formulation of packed-Shamir share/reconstruct.
+    [k, r] reconstruct matrix), ``b`` the batch-column data [..., m2, B]
+    with B huge — the batched formulation of packed-Shamir
+    share/reconstruct. Contraction runs as broadcast multiply + chunked
+    modular sum (no dot: TPU cannot lower s64 dot_general); exact for any
+    contraction size since partial sums are reduced every ``group`` terms.
     """
     if p >= MAX_MODULUS:
-        raise ValueError(f"modulus {p} >= 2^31 unsupported by limb modmatmul")
-    k = b.shape[-2] if b.ndim >= 2 else b.shape[0]  # contraction axis
-    if k * p * p < (1 << 62):
-        return _modmatmul_direct(a, b, p)
-    if k >= (1 << 15):
-        raise ValueError(f"contraction size {k} too large for limb modmatmul")
-    return _modmatmul_limb(a, b, p)
+        raise ValueError(f"modulus {p} >= 2^31 unsupported by modmatmul")
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    a_vec, b_vec = a.ndim == 1, b.ndim == 1  # matmul vector promotion rules
+    if a_vec:
+        a = a[None, :]
+    if b_vec:
+        b = b[:, None]
+    k = b.shape[-2]  # contraction axis
+    group = max(1, ((1 << 63) - 1) // (p * p))
+    # a: [..., n, k] -> [..., n, k, 1]; b: [..., k, B] -> [..., 1, k, B]
+    a = a[..., :, :, None]
+    b = b[..., None, :, :]
+    if k <= group:
+        out = jnp.mod(jnp.sum(a * b, axis=-2), p)
+    else:
+        acc = None
+        for start in range(0, k, group):
+            part = jnp.sum(
+                a[..., start : start + group, :] * b[..., start : start + group, :],
+                axis=-2,
+            )
+            acc = part if acc is None else acc + jnp.mod(part, p)
+            acc = jnp.mod(acc, p)
+        out = acc
+    if a_vec:
+        out = out[..., 0, :]
+    if b_vec:
+        out = out[..., 0]
+    return out
 
 
 def uniform_mod(key, shape, m: int):
@@ -100,17 +116,21 @@ def uniform_mod(key, shape, m: int):
 
 def np_modmatmul(a: np.ndarray, b: np.ndarray, p: int) -> np.ndarray:
     if p >= MAX_MODULUS:
-        raise ValueError(f"modulus {p} >= 2^31 unsupported by limb modmatmul")
+        raise ValueError(f"modulus {p} >= 2^31 unsupported by modmatmul")
     a = np.asarray(a, dtype=np.int64)
     b = np.asarray(b, dtype=np.int64)
     k = b.shape[-2] if b.ndim >= 2 else b.shape[0]  # contraction axis
-    if k * p * p < (1 << 62):
+    group = max(1, ((1 << 63) - 1) // (p * p))
+    if k * p * p < (1 << 63):
         return np.matmul(a, b) % p
-    if k >= (1 << 15):
-        raise ValueError(f"contraction size {k} too large for limb modmatmul")
-    hi = np.matmul(a, b >> 16)
-    lo = np.matmul(a, b & 0xFFFF)
-    return ((hi % p) * ((1 << 16) % p) + (lo % p)) % p
+    b_vec = b.ndim == 1
+    if b_vec:
+        b = b[:, None]
+    acc = None
+    for start in range(0, k, group):
+        part = np.matmul(a[..., start : start + group], b[..., start : start + group, :])
+        acc = part % p if acc is None else (acc + part % p) % p
+    return acc[..., 0] if b_vec else acc
 
 
 def np_modsum(x: np.ndarray, m: int, axis=0) -> np.ndarray:
