@@ -1,7 +1,9 @@
 """`sdad` — the server daemon CLI.
 
 Reference: server-cli (sdad --jfs|--mongo httpd, bind 127.0.0.1:8888).
-Backends here: durable JSON files (--jfs DIR) or in-memory (--memory).
+Backends here: durable JSON files (--jfs DIR), single-file SQLite database
+(--sqlite PATH — the production tier, reference analog --mongo), or
+in-memory (--memory).
 """
 
 from __future__ import annotations
@@ -15,6 +17,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="sdad", description="SDA server daemon")
     backend = parser.add_mutually_exclusive_group()
     backend.add_argument("--jfs", metavar="DIR", help="JSON-file store root")
+    backend.add_argument("--sqlite", metavar="PATH", help="SQLite database file")
     backend.add_argument("--memory", action="store_true", help="in-memory store")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -29,10 +32,12 @@ def main(argv=None) -> int:
         level=[logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
     )
     from ..http import SdaHttpServer
-    from ..server import new_jsonfs_server, new_memory_server
+    from ..server import new_jsonfs_server, new_memory_server, new_sqlite_server
 
     if args.memory:
         service = new_memory_server()
+    elif args.sqlite:
+        service = new_sqlite_server(args.sqlite)
     else:
         service = new_jsonfs_server(args.jfs or "./sdad-store")
 

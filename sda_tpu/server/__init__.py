@@ -15,6 +15,13 @@ from .jsonfs import (
     JsonAuthTokensStore,
     JsonClerkingJobsStore,
 )
+from .sqlite import (
+    SqliteAgentsStore,
+    SqliteAggregationsStore,
+    SqliteAuthTokensStore,
+    SqliteClerkingJobsStore,
+    SqliteDb,
+)
 from .stores import (
     AgentsStore,
     AggregationsStore,
@@ -34,6 +41,20 @@ def new_memory_server() -> SdaServerService:
             auth_tokens_store=MemoryAuthTokensStore(),
             aggregation_store=MemoryAggregationsStore(),
             clerking_job_store=MemoryClerkingJobsStore(),
+        )
+    )
+
+
+def new_sqlite_server(path) -> SdaServerService:
+    """Single-file database server — the production-database tier
+    (reference analog: the MongoDB backend, server-store-mongodb/)."""
+    db = SqliteDb(path)
+    return SdaServerService(
+        SdaServer(
+            agents_store=SqliteAgentsStore(db),
+            auth_tokens_store=SqliteAuthTokensStore(db),
+            aggregation_store=SqliteAggregationsStore(db),
+            clerking_job_store=SqliteClerkingJobsStore(db),
         )
     )
 

@@ -1,0 +1,413 @@
+"""SQLite store backend — the production-database store.
+
+The reference ships a production MongoDB backend next to its JSON-file one
+(server-store-mongodb/src/lib.rs:86-151); this is the same tier for sda-tpu,
+built on the stdlib ``sqlite3`` so it needs no external service. Design
+follows the Mongo store's shape, not the file store's:
+
+- one document table per resource, JSON text keyed by id, upserts via
+  ``INSERT .. ON CONFLICT`` (the Mongo store's ``modisert`` helper,
+  lib.rs:118-151);
+- snapshotting marks frozen participations in a join table — the analog of
+  ``$addToSet``-ing the snapshot id onto participation docs
+  (server-store-mongodb/src/aggregations.rs:132-142);
+- the clerk-job queue is a done-flag column, result creation flips it in the
+  same transaction (clerking_jobs.rs:32-75 done-flag queue);
+- the snapshot transpose runs as one SQL join ordered by committee position,
+  the analog of the Mongo $match→$unwind→$group pipeline
+  (aggregations.rs:164-195).
+
+All four stores share one database handle (single writer, WAL) so a whole
+server lives in one ``.db`` file — durable-by-construction like every other
+backend (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from typing import List
+
+from ..protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    ClerkCandidate,
+    ClerkingJob,
+    ClerkingJobId,
+    ClerkingResult,
+    Committee,
+    Encryption,
+    EncryptionKeyId,
+    NotFound,
+    Participation,
+    Profile,
+    Snapshot,
+    SnapshotId,
+    signed_encryption_key_from_obj,
+)
+from .stores import (
+    AgentsStore,
+    AggregationsStore,
+    AuthTokensStore,
+    BaseStore,
+    ClerkingJobsStore,
+    auth_token,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS auth_tokens (
+    id TEXT PRIMARY KEY, body TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS agents (
+    id TEXT PRIMARY KEY, doc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS profiles (
+    owner TEXT PRIMARY KEY, doc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS enc_keys (
+    id TEXT PRIMARY KEY, signer TEXT NOT NULL, doc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS aggregations (
+    id TEXT PRIMARY KEY, title TEXT NOT NULL, recipient TEXT NOT NULL,
+    doc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS committees (
+    aggregation TEXT PRIMARY KEY, doc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS participations (
+    id TEXT NOT NULL, aggregation TEXT NOT NULL, doc TEXT NOT NULL,
+    PRIMARY KEY (aggregation, id));
+CREATE TABLE IF NOT EXISTS snapshots (
+    id TEXT NOT NULL, aggregation TEXT NOT NULL, doc TEXT NOT NULL,
+    PRIMARY KEY (aggregation, id));
+CREATE TABLE IF NOT EXISTS snapshot_parts (
+    snapshot TEXT NOT NULL, participation TEXT NOT NULL,
+    PRIMARY KEY (snapshot, participation));
+CREATE TABLE IF NOT EXISTS snapshot_masks (
+    snapshot TEXT PRIMARY KEY, doc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS clerking_jobs (
+    id TEXT NOT NULL, clerk TEXT NOT NULL, snapshot TEXT NOT NULL,
+    done INTEGER NOT NULL DEFAULT 0, doc TEXT NOT NULL,
+    PRIMARY KEY (clerk, id));
+CREATE INDEX IF NOT EXISTS ix_jobs_queue ON clerking_jobs (clerk, done, id);
+CREATE TABLE IF NOT EXISTS clerking_results (
+    job TEXT NOT NULL, snapshot TEXT NOT NULL, doc TEXT NOT NULL,
+    PRIMARY KEY (snapshot, job));
+"""
+
+
+class SqliteDb:
+    """Shared single-writer handle; ``":memory:"`` works for tests."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self.lock = threading.RLock()
+        self.conn = sqlite3.connect(self.path, check_same_thread=False)
+        with self.lock, self.conn:
+            if self.path != ":memory:":
+                self.conn.execute("PRAGMA journal_mode=WAL")
+            self.conn.executescript(_SCHEMA)
+
+    def ping(self) -> None:
+        with self.lock:
+            self.conn.execute("SELECT 1").fetchone()
+
+
+class _SqliteStore(BaseStore):
+    def __init__(self, db: SqliteDb):
+        self.db = db
+
+    def ping(self) -> None:
+        self.db.ping()
+
+    def _one(self, sql: str, args=()):
+        with self.db.lock:
+            row = self.db.conn.execute(sql, args).fetchone()
+        return row
+
+    def _all(self, sql: str, args=()):
+        with self.db.lock:
+            return self.db.conn.execute(sql, args).fetchall()
+
+    def _exec(self, sql: str, args=()):
+        with self.db.lock, self.db.conn:
+            self.db.conn.execute(sql, args)
+
+
+class SqliteAuthTokensStore(_SqliteStore, AuthTokensStore):
+    def upsert_auth_token(self, token):
+        self._exec(
+            "INSERT INTO auth_tokens (id, body) VALUES (?, ?) "
+            "ON CONFLICT (id) DO UPDATE SET body = excluded.body",
+            (str(token.id), token.body),
+        )
+
+    def get_auth_token(self, id):
+        row = self._one("SELECT body FROM auth_tokens WHERE id = ?", (str(id),))
+        return None if row is None else auth_token(id, row[0])
+
+    def delete_auth_token(self, id):
+        self._exec("DELETE FROM auth_tokens WHERE id = ?", (str(id),))
+
+
+class SqliteAgentsStore(_SqliteStore, AgentsStore):
+    def create_agent(self, agent):
+        self._exec(
+            "INSERT INTO agents (id, doc) VALUES (?, ?) "
+            "ON CONFLICT (id) DO UPDATE SET doc = excluded.doc",
+            (str(agent.id), json.dumps(agent.to_obj())),
+        )
+
+    def get_agent(self, id):
+        row = self._one("SELECT doc FROM agents WHERE id = ?", (str(id),))
+        return None if row is None else Agent.from_obj(json.loads(row[0]))
+
+    def upsert_profile(self, profile):
+        self._exec(
+            "INSERT INTO profiles (owner, doc) VALUES (?, ?) "
+            "ON CONFLICT (owner) DO UPDATE SET doc = excluded.doc",
+            (str(profile.owner), json.dumps(profile.to_obj())),
+        )
+
+    def get_profile(self, owner):
+        row = self._one("SELECT doc FROM profiles WHERE owner = ?", (str(owner),))
+        return None if row is None else Profile.from_obj(json.loads(row[0]))
+
+    def create_encryption_key(self, key):
+        self._exec(
+            "INSERT INTO enc_keys (id, signer, doc) VALUES (?, ?, ?) "
+            "ON CONFLICT (id) DO UPDATE SET signer = excluded.signer, "
+            "doc = excluded.doc",
+            (str(key.body.id), str(key.signer), json.dumps(key.to_obj())),
+        )
+
+    def get_encryption_key(self, key):
+        row = self._one("SELECT doc FROM enc_keys WHERE id = ?", (str(key),))
+        return None if row is None else signed_encryption_key_from_obj(json.loads(row[0]))
+
+    def suggest_committee(self):
+        rows = self._all("SELECT signer, id FROM enc_keys ORDER BY signer, id")
+        candidates: List[ClerkCandidate] = []
+        for signer, key_id in rows:
+            if candidates and str(candidates[-1].id) == signer:
+                candidates[-1].keys.append(EncryptionKeyId(key_id))
+            else:
+                candidates.append(
+                    ClerkCandidate(id=AgentId(signer), keys=[EncryptionKeyId(key_id)])
+                )
+        return candidates
+
+
+class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
+    def list_aggregations(self, filter=None, recipient=None):
+        sql = "SELECT id FROM aggregations"
+        clauses, args = [], []
+        if filter is not None:
+            clauses.append("instr(title, ?) > 0")
+            args.append(filter)
+        if recipient is not None:
+            clauses.append("recipient = ?")
+            args.append(str(recipient))
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        sql += " ORDER BY id"
+        return [AggregationId(r[0]) for r in self._all(sql, tuple(args))]
+
+    def create_aggregation(self, aggregation):
+        self._exec(
+            "INSERT INTO aggregations (id, title, recipient, doc) VALUES (?, ?, ?, ?) "
+            "ON CONFLICT (id) DO UPDATE SET title = excluded.title, "
+            "recipient = excluded.recipient, doc = excluded.doc",
+            (
+                str(aggregation.id),
+                aggregation.title,
+                str(aggregation.recipient),
+                json.dumps(aggregation.to_obj()),
+            ),
+        )
+
+    def get_aggregation(self, aggregation):
+        row = self._one("SELECT doc FROM aggregations WHERE id = ?", (str(aggregation),))
+        return None if row is None else Aggregation.from_obj(json.loads(row[0]))
+
+    def delete_aggregation(self, aggregation):
+        agg = str(aggregation)
+        with self.db.lock, self.db.conn:
+            for table in ("snapshot_parts", "snapshot_masks"):
+                self.db.conn.execute(
+                    f"DELETE FROM {table} WHERE snapshot IN "
+                    "(SELECT id FROM snapshots WHERE aggregation = ?)",
+                    (agg,),
+                )
+            self.db.conn.execute(
+                "DELETE FROM participations WHERE aggregation = ?", (agg,)
+            )
+            self.db.conn.execute("DELETE FROM snapshots WHERE aggregation = ?", (agg,))
+            self.db.conn.execute("DELETE FROM committees WHERE aggregation = ?", (agg,))
+            self.db.conn.execute("DELETE FROM aggregations WHERE id = ?", (agg,))
+
+    def get_committee(self, aggregation):
+        row = self._one(
+            "SELECT doc FROM committees WHERE aggregation = ?", (str(aggregation),)
+        )
+        return None if row is None else Committee.from_obj(json.loads(row[0]))
+
+    def create_committee(self, committee):
+        self._exec(
+            "INSERT INTO committees (aggregation, doc) VALUES (?, ?) "
+            "ON CONFLICT (aggregation) DO UPDATE SET doc = excluded.doc",
+            (str(committee.aggregation), json.dumps(committee.to_obj())),
+        )
+
+    def create_participation(self, participation):
+        with self.db.lock, self.db.conn:
+            exists = self.db.conn.execute(
+                "SELECT 1 FROM aggregations WHERE id = ?",
+                (str(participation.aggregation),),
+            ).fetchone()
+            if exists is None:
+                raise NotFound("aggregation not found")
+            self.db.conn.execute(
+                "INSERT INTO participations (id, aggregation, doc) VALUES (?, ?, ?) "
+                "ON CONFLICT (aggregation, id) DO UPDATE SET doc = excluded.doc",
+                (
+                    str(participation.id),
+                    str(participation.aggregation),
+                    json.dumps(participation.to_obj()),
+                ),
+            )
+
+    def create_snapshot(self, snapshot):
+        self._exec(
+            "INSERT INTO snapshots (id, aggregation, doc) VALUES (?, ?, ?) "
+            "ON CONFLICT (aggregation, id) DO UPDATE SET doc = excluded.doc",
+            (
+                str(snapshot.id),
+                str(snapshot.aggregation),
+                json.dumps(snapshot.to_obj()),
+            ),
+        )
+
+    def list_snapshots(self, aggregation):
+        rows = self._all(
+            "SELECT id FROM snapshots WHERE aggregation = ? ORDER BY id",
+            (str(aggregation),),
+        )
+        return [SnapshotId(r[0]) for r in rows]
+
+    def get_snapshot(self, aggregation, snapshot):
+        row = self._one(
+            "SELECT doc FROM snapshots WHERE aggregation = ? AND id = ?",
+            (str(aggregation), str(snapshot)),
+        )
+        return None if row is None else Snapshot.from_obj(json.loads(row[0]))
+
+    def count_participations(self, aggregation):
+        row = self._one(
+            "SELECT COUNT(*) FROM participations WHERE aggregation = ?",
+            (str(aggregation),),
+        )
+        return row[0]
+
+    def snapshot_participations(self, aggregation, snapshot):
+        # the $addToSet moment: freeze exactly the rows present now
+        with self.db.lock, self.db.conn:
+            self.db.conn.execute(
+                "INSERT OR IGNORE INTO snapshot_parts (snapshot, participation) "
+                "SELECT ?, id FROM participations WHERE aggregation = ?",
+                (str(snapshot), str(aggregation)),
+            )
+
+    def count_participations_snapshot(self, aggregation, snapshot):
+        row = self._one(
+            "SELECT COUNT(*) FROM snapshot_parts WHERE snapshot = ?", (str(snapshot),)
+        )
+        return row[0]
+
+    def iter_snapped_participations(self, aggregation, snapshot):
+        rows = self._all(
+            "SELECT p.doc FROM snapshot_parts s "
+            "JOIN participations p ON p.id = s.participation AND p.aggregation = ? "
+            "WHERE s.snapshot = ? ORDER BY p.id",
+            (str(aggregation), str(snapshot)),
+        )
+        return [Participation.from_obj(json.loads(r[0])) for r in rows]
+
+    def create_snapshot_mask(self, snapshot, mask):
+        self._exec(
+            "INSERT INTO snapshot_masks (snapshot, doc) VALUES (?, ?) "
+            "ON CONFLICT (snapshot) DO UPDATE SET doc = excluded.doc",
+            (str(snapshot), json.dumps([e.to_obj() for e in mask])),
+        )
+
+    def get_snapshot_mask(self, snapshot):
+        row = self._one(
+            "SELECT doc FROM snapshot_masks WHERE snapshot = ?", (str(snapshot),)
+        )
+        if row is None:
+            return None
+        return [Encryption.from_obj(e) for e in json.loads(row[0])]
+
+
+class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
+    def enqueue_clerking_job(self, job):
+        self._exec(
+            "INSERT INTO clerking_jobs (id, clerk, snapshot, done, doc) "
+            "VALUES (?, ?, ?, 0, ?) "
+            "ON CONFLICT (clerk, id) DO UPDATE SET doc = excluded.doc",
+            (
+                str(job.id),
+                str(job.clerk),
+                str(job.snapshot),
+                json.dumps(job.to_obj()),
+            ),
+        )
+
+    def poll_clerking_job(self, clerk):
+        row = self._one(
+            "SELECT doc FROM clerking_jobs WHERE clerk = ? AND done = 0 "
+            "ORDER BY id LIMIT 1",
+            (str(clerk),),
+        )
+        return None if row is None else ClerkingJob.from_obj(json.loads(row[0]))
+
+    def get_clerking_job(self, clerk, job):
+        row = self._one(
+            "SELECT doc FROM clerking_jobs WHERE clerk = ? AND id = ?",
+            (str(clerk), str(job)),
+        )
+        return None if row is None else ClerkingJob.from_obj(json.loads(row[0]))
+
+    def create_clerking_result(self, result):
+        # result write + done-flag flip, atomically (the Mongo store's
+        # done-flag queue semantics, clerking_jobs.rs:32-75)
+        with self.db.lock, self.db.conn:
+            row = self.db.conn.execute(
+                "SELECT snapshot, done FROM clerking_jobs WHERE clerk = ? AND id = ?",
+                (str(result.clerk), str(result.job)),
+            ).fetchone()
+            if row is None:
+                raise NotFound("job not found for clerk")
+            snapshot, done = row
+            if done:
+                return  # duplicate result upload: idempotent
+            self.db.conn.execute(
+                "INSERT INTO clerking_results (job, snapshot, doc) VALUES (?, ?, ?) "
+                "ON CONFLICT (snapshot, job) DO UPDATE SET doc = excluded.doc",
+                (str(result.job), snapshot, json.dumps(result.to_obj())),
+            )
+            self.db.conn.execute(
+                "UPDATE clerking_jobs SET done = 1 WHERE clerk = ? AND id = ?",
+                (str(result.clerk), str(result.job)),
+            )
+
+    def list_results(self, snapshot):
+        rows = self._all(
+            "SELECT job FROM clerking_results WHERE snapshot = ? ORDER BY job",
+            (str(snapshot),),
+        )
+        return [ClerkingJobId(r[0]) for r in rows]
+
+    def get_result(self, snapshot, job):
+        row = self._one(
+            "SELECT doc FROM clerking_results WHERE snapshot = ? AND job = ?",
+            (str(snapshot), str(job)),
+        )
+        return None if row is None else ClerkingResult.from_obj(json.loads(row[0]))

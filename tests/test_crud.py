@@ -18,15 +18,22 @@ from sda_tpu.protocol import (
     InvalidCredentials,
     SodiumEncryption,
 )
-from sda_tpu.server import auth_token, new_jsonfs_server, new_memory_server
+from sda_tpu.server import (
+    auth_token,
+    new_jsonfs_server,
+    new_memory_server,
+    new_sqlite_server,
+)
 
 from util import new_agent, new_full_agent, new_key_for_agent
 
 
-@pytest.fixture(params=["memory", "jsonfs"])
+@pytest.fixture(params=["memory", "jsonfs", "sqlite"])
 def service(request, tmp_path):
     if request.param == "memory":
         return new_memory_server()
+    if request.param == "sqlite":
+        return new_sqlite_server(tmp_path / "sda.db")
     return new_jsonfs_server(tmp_path)
 
 

@@ -25,7 +25,7 @@ from sda_tpu.protocol import (
     PackedShamirSharing,
     SodiumEncryption,
 )
-from sda_tpu.server import new_jsonfs_server, new_memory_server
+from sda_tpu.server import new_jsonfs_server, new_memory_server, new_sqlite_server
 from sda_tpu.store import Filebased
 
 pytestmark = pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
@@ -106,10 +106,12 @@ def check_full_aggregation(aggregation: Aggregation, service):
     np.testing.assert_array_equal(output.positive().values, [2, 4, 6, 8])
 
 
-@pytest.fixture(params=["memory", "jsonfs", "http"])
+@pytest.fixture(params=["memory", "jsonfs", "sqlite", "http"])
 def service(request, tmp_path):
     if request.param == "memory":
         yield new_memory_server()
+    elif request.param == "sqlite":
+        yield new_sqlite_server(tmp_path / "sda.db")
     elif request.param == "jsonfs":
         yield new_jsonfs_server(tmp_path)
     else:

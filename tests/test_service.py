@@ -24,7 +24,7 @@ from sda_tpu.protocol import (
     SnapshotId,
     SodiumEncryption,
 )
-from sda_tpu.server import new_jsonfs_server, new_memory_server
+from sda_tpu.server import new_jsonfs_server, new_memory_server, new_sqlite_server
 
 from util import mock_encryption, new_agent, new_full_agent
 
@@ -32,10 +32,12 @@ N_PARTICIPANTS = 100
 N_CLERKS = 3
 
 
-@pytest.fixture(params=["memory", "jsonfs"])
+@pytest.fixture(params=["memory", "jsonfs", "sqlite"])
 def service(request, tmp_path):
     if request.param == "memory":
         return new_memory_server()
+    if request.param == "sqlite":
+        return new_sqlite_server(tmp_path / "sda.db")
     return new_jsonfs_server(tmp_path)
 
 
