@@ -13,9 +13,10 @@ follows the Mongo store's shape, not the file store's:
   (server-store-mongodb/src/aggregations.rs:132-142);
 - the clerk-job queue is a done-flag column, result creation flips it in the
   same transaction (clerking_jobs.rs:32-75 done-flag queue);
-- the snapshot transpose runs as one SQL join ordered by committee position,
-  the analog of the Mongo $match→$unwind→$group pipeline
-  (aggregations.rs:164-195).
+- snapshot reads fetch frozen participations with one SQL join
+  (``iter_snapped_participations``); the per-clerk transpose itself uses the
+  shared default from ``stores.py`` (the Mongo store instead pushes it into a
+  $match→$unwind→$group pipeline, aggregations.rs:164-195).
 
 All four stores share one database handle (single writer, WAL) so a whole
 server lives in one ``.db`` file — durable-by-construction like every other

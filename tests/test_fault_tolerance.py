@@ -1,0 +1,176 @@
+"""Fault injection: clerk dropout and quorum reconstruction.
+
+The reference has no fault-injection tests; its resilience is protocol-native
+(SURVEY.md §5.3): packed Shamir tolerates clerk loss because a snapshot's
+result is ready as soon as ``reconstruction_threshold`` results exist
+(server/src/server.rs:115-121) and reconstruction interpolates through an
+arbitrary surviving index set (client/src/receive.rs:127-138,
+protocol/src/crypto.rs:146-153). These tests exercise exactly that:
+kill clerks, assert the round still reveals bit-exactly — or fails closed
+when the quorum cannot be met.
+"""
+
+import numpy as np
+import pytest
+
+from sda_tpu.crypto import MemoryKeystore, sodium
+from sda_tpu.client import SdaClient
+from sda_tpu.fields import numtheory, oracle
+from sda_tpu.protocol import (
+    Aggregation,
+    AggregationId,
+    AgentId,
+    EncryptionKeyId,
+    FullMasking,
+    NoMasking,
+    NotFound,
+    PackedShamirSharing,
+    SodiumEncryption,
+)
+from sda_tpu.server import new_memory_server
+
+GOLDEN = PackedShamirSharing(
+    secret_count=3, share_count=8, privacy_threshold=4,
+    prime_modulus=433, omega_secrets=354, omega_shares=150,
+)
+
+
+# ---------------------------------------------------------------------------
+# Kernel-level quorum property: every reconstructing subset is exact
+
+def test_packed_reconstruct_every_minimal_subset():
+    """share -> reconstruct == id for ALL size-7 subsets of 8 clerk rows."""
+    import itertools
+
+    s = GOLDEN
+    rng = np.random.default_rng(7)
+    secrets = rng.integers(0, s.prime_modulus, size=11)
+    B = -(-len(secrets) // s.secret_count)
+    randomness = rng.integers(0, s.prime_modulus, size=(s.privacy_threshold, B))
+    shares = oracle.packed_share_from_randomness(secrets, randomness, s)  # [n, B]
+    r = s.reconstruction_threshold
+    assert r == 7
+    for subset in itertools.combinations(range(s.share_count), r):
+        got = oracle.packed_reconstruct(
+            subset, shares[list(subset)], s, dimension=len(secrets)
+        )
+        np.testing.assert_array_equal(got, secrets)
+
+
+def test_packed_reconstruct_below_quorum_rejected():
+    s = GOLDEN
+    with pytest.raises(ValueError, match="need at least"):
+        numtheory.packed_reconstruct_matrix(
+            s.secret_count, s.share_count, s.privacy_threshold,
+            s.prime_modulus, s.omega_secrets, s.omega_shares,
+            tuple(range(s.reconstruction_threshold - 1)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Protocol-level dropout: full loop with killed clerks
+
+needs_sodium = pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+
+
+def _new_client(service):
+    keystore = MemoryKeystore()
+    agent = SdaClient.new_agent(keystore)
+    client = SdaClient(agent, keystore, service)
+    client.upload_agent()
+    return client
+
+
+def _build_round(service, masking):
+    recipient = _new_client(service)
+    recipient_key = recipient.new_encryption_key()
+    recipient.upload_encryption_key(recipient_key)
+
+    clerks = {}
+    for _ in range(GOLDEN.share_count + 1):  # spares: recipient is a candidate too
+        clerk = _new_client(service)
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+        clerks[clerk.agent.id] = clerk
+    clerks[recipient.agent.id] = recipient
+
+    aggregation = Aggregation(
+        id=AggregationId.random(),
+        title="dropout",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=recipient_key,
+        masking_scheme=masking,
+        committee_sharing_scheme=GOLDEN,
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    recipient.upload_aggregation(aggregation)
+    recipient.begin_aggregation(aggregation.id)
+
+    for offset in range(2):
+        participant = _new_client(service)
+        participant.participate([1 + offset, 2 + offset, 3 + offset, 4 + offset],
+                                aggregation.id)
+    recipient.end_aggregation(aggregation.id)
+
+    committee = service.get_committee(recipient.agent, aggregation.id)
+    members = [clerks[cid] for (cid, _) in committee.clerks_and_keys]
+    return recipient, aggregation, members
+
+
+@needs_sodium
+@pytest.mark.parametrize("masking", [NoMasking(), FullMasking(433)])
+def test_clerk_dropout_at_quorum_reveals_exact(masking):
+    """Kill one clerk of 8: 7 results == reconstruction_threshold -> exact."""
+    service = new_memory_server()
+    recipient, aggregation, members = _build_round(service, masking)
+
+    dead = members[3]  # arbitrary victim; never polls its job
+    for clerk in members:
+        if clerk is not dead:
+            clerk.run_chores(-1)
+
+    status = recipient.service.get_aggregation_status(recipient.agent, aggregation.id)
+    snap = status.snapshots[0]
+    assert snap.number_of_clerking_results == GOLDEN.reconstruction_threshold
+    assert snap.result_ready
+
+    output = recipient.reveal_aggregation(aggregation.id)
+    np.testing.assert_array_equal(output.positive().values, [3, 5, 7, 9])
+
+
+@needs_sodium
+def test_clerk_dropout_below_quorum_fails_closed():
+    """Kill two clerks of 8: 6 results < threshold -> not ready, no reveal."""
+    service = new_memory_server()
+    recipient, aggregation, members = _build_round(service, NoMasking())
+
+    for clerk in members[2:]:
+        clerk.run_chores(-1)
+
+    status = recipient.service.get_aggregation_status(recipient.agent, aggregation.id)
+    snap = status.snapshots[0]
+    assert snap.number_of_clerking_results == GOLDEN.reconstruction_threshold - 1
+    assert not snap.result_ready
+    with pytest.raises(NotFound, match="not ready"):
+        recipient.reveal_aggregation(aggregation.id)
+
+
+@needs_sodium
+def test_late_clerk_completes_round_after_not_ready():
+    """A straggler clerk finishing later flips the round to ready — the
+    reference's stateless re-poll resume model (SURVEY.md §5.4)."""
+    service = new_memory_server()
+    recipient, aggregation, members = _build_round(service, NoMasking())
+
+    for clerk in members[2:]:
+        clerk.run_chores(-1)
+    status = recipient.service.get_aggregation_status(recipient.agent, aggregation.id)
+    assert not status.snapshots[0].result_ready
+
+    members[0].run_chores(-1)  # straggler wakes up
+    status = recipient.service.get_aggregation_status(recipient.agent, aggregation.id)
+    assert status.snapshots[0].result_ready
+    output = recipient.reveal_aggregation(aggregation.id)
+    np.testing.assert_array_equal(output.positive().values, [3, 5, 7, 9])
