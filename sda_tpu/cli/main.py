@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import logging
 import sys
 import time
 
@@ -102,9 +101,9 @@ def _primary_key(client: SdaClient, store: Filebased) -> EncryptionKeyId:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=[logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
-    )
+    from ..utils import configure_logging
+
+    configure_logging(args.verbose)
     client = load_client(args)
     store: Filebased = client.crypto.keystore  # type: ignore[assignment]
 

@@ -9,7 +9,6 @@ in-memory (--memory).
 from __future__ import annotations
 
 import argparse
-import logging
 import sys
 
 
@@ -28,9 +27,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=[logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
-    )
+    from ..utils import configure_logging
+
+    configure_logging(args.verbose)
     from ..http import SdaHttpServer
     from ..server import new_jsonfs_server, new_memory_server, new_sqlite_server
 

@@ -14,6 +14,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..crypto import CryptoModule, Keystore, signature_is_valid
+from ..utils import timed_phase
 from ..protocol import (
     Agent,
     AgentId,
@@ -107,7 +108,8 @@ class SdaClient:
 
         # mask the secrets
         masker = self.crypto.new_secret_masker(aggregation.masking_scheme)
-        recipient_mask, masked_secrets = masker.mask(secrets)
+        with timed_phase("participant.mask"):
+            recipient_mask, masked_secrets = masker.mask(secrets)
 
         recipient_encryption = None
         if len(recipient_mask) > 0:
@@ -121,17 +123,19 @@ class SdaClient:
 
         # share the masked secrets; row i -> clerk i
         generator = self.crypto.new_share_generator(aggregation.committee_sharing_scheme)
-        shares_per_clerk = generator.generate(masked_secrets)
+        with timed_phase("participant.share"):
+            shares_per_clerk = generator.generate(masked_secrets)
 
         clerk_encryptions = []
-        for (clerk_id, clerk_key_id), clerk_shares in zip(
-            committee.clerks_and_keys, shares_per_clerk
-        ):
-            clerk_key = self._fetch_verified_key(clerk_id, clerk_key_id)
-            encryptor = self.crypto.new_share_encryptor(
-                clerk_key, aggregation.committee_encryption_scheme
-            )
-            clerk_encryptions.append((clerk_id, encryptor.encrypt(clerk_shares)))
+        with timed_phase("participant.encrypt"):
+            for (clerk_id, clerk_key_id), clerk_shares in zip(
+                committee.clerks_and_keys, shares_per_clerk
+            ):
+                clerk_key = self._fetch_verified_key(clerk_id, clerk_key_id)
+                encryptor = self.crypto.new_share_encryptor(
+                    clerk_key, aggregation.committee_encryption_scheme
+                )
+                clerk_encryptions.append((clerk_id, encryptor.encrypt(clerk_shares)))
 
         return Participation(
             id=ParticipationId.random(),
@@ -198,10 +202,12 @@ class SdaClient:
         decryptor = self.crypto.new_share_decryptor(
             own_key_id, aggregation.committee_encryption_scheme
         )
-        share_vectors = [decryptor.decrypt(e) for e in job.encryptions]
+        with timed_phase("clerk.decrypt"):
+            share_vectors = [decryptor.decrypt(e) for e in job.encryptions]
 
         combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
-        combined = combiner.combine(share_vectors)
+        with timed_phase("clerk.combine"):
+            combined = combiner.combine(share_vectors)
 
         recipient_key = self._fetch_verified_key(
             aggregation.recipient, aggregation.recipient_key
@@ -209,8 +215,10 @@ class SdaClient:
         encryptor = self.crypto.new_share_encryptor(
             recipient_key, aggregation.recipient_encryption_scheme
         )
+        with timed_phase("clerk.encrypt"):
+            result_encryption = encryptor.encrypt(combined)
         return ClerkingResult(
-            job=job.id, clerk=job.clerk, encryption=encryptor.encrypt(combined)
+            job=job.id, clerk=job.clerk, encryption=result_encryption
         )
 
     # ------------------------------------------------------------------
@@ -267,26 +275,30 @@ class SdaClient:
         )
 
         # combine masks (expanding seeds for ChaCha)
-        if result.recipient_encryptions is None:
-            mask = np.zeros(0, dtype=np.int64)
-        else:
-            decrypted = [decryptor.decrypt(e) for e in result.recipient_encryptions]
-            mask = self.crypto.new_mask_combiner(aggregation.masking_scheme).combine(decrypted)
+        with timed_phase("recipient.combine_masks"):
+            if result.recipient_encryptions is None:
+                mask = np.zeros(0, dtype=np.int64)
+            else:
+                decrypted = [decryptor.decrypt(e) for e in result.recipient_encryptions]
+                mask = self.crypto.new_mask_combiner(aggregation.masking_scheme).combine(decrypted)
 
         # decrypt clerk results, map clerk id -> committee index
         clerk_positions = {cid: ix for ix, (cid, _) in enumerate(committee.clerks_and_keys)}
         indexed_shares = []
-        for clerking_result in result.clerk_encryptions:
-            ix = clerk_positions.get(clerking_result.clerk)
-            if ix is None:
-                raise NotFound(f"missing clerk {clerking_result.clerk}")
-            indexed_shares.append((ix, decryptor.decrypt(clerking_result.encryption)))
+        with timed_phase("recipient.decrypt_results"):
+            for clerking_result in result.clerk_encryptions:
+                ix = clerk_positions.get(clerking_result.clerk)
+                if ix is None:
+                    raise NotFound(f"missing clerk {clerking_result.clerk}")
+                indexed_shares.append((ix, decryptor.decrypt(clerking_result.encryption)))
 
         reconstructor = self.crypto.new_secret_reconstructor(
             aggregation.committee_sharing_scheme, aggregation.vector_dimension
         )
-        masked_output = reconstructor.reconstruct(indexed_shares)
+        with timed_phase("recipient.reconstruct"):
+            masked_output = reconstructor.reconstruct(indexed_shares)
 
         unmasker = self.crypto.new_secret_unmasker(aggregation.masking_scheme)
-        output = unmasker.unmask(mask, masked_output)
+        with timed_phase("recipient.unmask"):
+            output = unmasker.unmask(mask, masked_output)
         return RecipientOutput(modulus=aggregation.modulus, values=output)

@@ -43,6 +43,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..fields import modular, numtheory, sharing
+from ..utils import timed_phase
 from ..protocol import (
     FullMasking,
     LinearMaskingScheme,
@@ -210,8 +211,13 @@ class SimulatedPod:
             self._step = self._build(*shape)
             self._step_shape = shape
         sharding = NamedSharding(self.mesh, P("p", "d"))
-        inputs = jax.device_put(inputs, sharding)
-        return self._step(inputs, key)
+        # first round per shape includes jit compilation (jax.jit is lazy):
+        # it shows in the phase stats as max_s >> min_s
+        with timed_phase("mesh.round"):
+            inputs = jax.device_put(inputs, sharding)
+            out = self._step(inputs, key)
+            out.block_until_ready()
+        return out
 
     def aggregate_fn(self, P_total: int, d_total: int):
         """The raw jitted SPMD round for benchmarking/compile checks."""

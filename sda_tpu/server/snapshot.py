@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 
 from ..protocol import ClerkingJob, ClerkingJobId, NotFound, Snapshot
+from ..utils import timed_phase
 
 log = logging.getLogger(__name__)
 
@@ -21,28 +22,31 @@ def snapshot(server, snap: Snapshot) -> None:
     if aggregation is None:
         raise NotFound("lost aggregation")
     log.debug("snapshot %s: freezing participations", snap.id)
-    server.aggregation_store.snapshot_participations(snap.aggregation, snap.id)
+    with timed_phase("server.snapshot_freeze"):
+        server.aggregation_store.snapshot_participations(snap.aggregation, snap.id)
 
     committee = server.get_committee(snap.aggregation)
     if committee is None:
         raise NotFound("lost committee")
 
     log.debug("snapshot %s: transposing encryptions", snap.id)
-    columns = server.aggregation_store.iter_snapshot_clerk_jobs_data(
-        snap.aggregation, snap.id, len(committee.clerks_and_keys)
-    )
+    with timed_phase("server.transpose"):
+        columns = server.aggregation_store.iter_snapshot_clerk_jobs_data(
+            snap.aggregation, snap.id, len(committee.clerks_and_keys)
+        )
 
     log.debug("snapshot %s: enqueueing %d clerking jobs", snap.id, len(columns))
-    for (clerk_id, _), encryptions in zip(committee.clerks_and_keys, columns):
-        server.clerking_job_store.enqueue_clerking_job(
-            ClerkingJob(
-                id=ClerkingJobId.random(),
-                clerk=clerk_id,
-                aggregation=snap.aggregation,
-                snapshot=snap.id,
-                encryptions=encryptions,
+    with timed_phase("server.enqueue_jobs"):
+        for (clerk_id, _), encryptions in zip(committee.clerks_and_keys, columns):
+            server.clerking_job_store.enqueue_clerking_job(
+                ClerkingJob(
+                    id=ClerkingJobId.random(),
+                    clerk=clerk_id,
+                    aggregation=snap.aggregation,
+                    snapshot=snap.id,
+                    encryptions=encryptions,
+                )
             )
-        )
 
     server.aggregation_store.create_snapshot(snap)
 

@@ -1,0 +1,19 @@
+"""Cross-cutting utilities: phase timing, profiler hooks, logging setup."""
+
+from .timing import (
+    PhaseStat,
+    phase_report,
+    profile_trace,
+    reset_phase_report,
+    timed_phase,
+)
+from .logsetup import configure_logging
+
+__all__ = [
+    "PhaseStat",
+    "configure_logging",
+    "phase_report",
+    "profile_trace",
+    "reset_phase_report",
+    "timed_phase",
+]

@@ -1,0 +1,20 @@
+"""Structured logging setup shared by the CLIs and daemons.
+
+Reference: slog 1.x with -v verbosity flags (cli/src/main.rs:83-88,
+server-cli/src/lib.rs:29-36); here stdlib logging with one canonical
+format: timestamp, level, logger, message.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_LEVELS = [logging.WARNING, logging.INFO, logging.DEBUG]
+FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+
+
+def configure_logging(verbosity: int = 0) -> None:
+    """verbosity 0 -> WARNING, 1 -> INFO, >=2 -> DEBUG (the -v/-vv flags)."""
+    logging.basicConfig(
+        level=_LEVELS[min(int(verbosity), len(_LEVELS) - 1)], format=FORMAT
+    )

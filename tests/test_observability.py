@@ -1,0 +1,104 @@
+"""Phase timing and logging setup (reference gap: SURVEY §5.1/§5.5).
+
+The reference ships no tracing/metrics at all; sda-tpu times every protocol
+phase. These tests assert the registry fills during a real round and that
+the stats are sane.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from sda_tpu.crypto import MemoryKeystore, sodium
+from sda_tpu.client import SdaClient
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    FullMasking,
+    SodiumEncryption,
+)
+from sda_tpu.server import new_memory_server
+from sda_tpu.utils import configure_logging, phase_report, reset_phase_report, timed_phase
+
+
+def test_timed_phase_accumulates():
+    reset_phase_report()
+    for _ in range(3):
+        with timed_phase("unit.test_phase"):
+            pass
+    report = phase_report()
+    stat = report["unit.test_phase"]
+    assert stat["count"] == 3
+    assert stat["total_s"] >= 0.0
+    assert stat["min_s"] <= stat["mean_s"] <= stat["max_s"]
+
+
+def test_timed_phase_records_on_exception():
+    reset_phase_report()
+    with pytest.raises(RuntimeError):
+        with timed_phase("unit.failing_phase"):
+            raise RuntimeError("boom")
+    assert phase_report()["unit.failing_phase"]["count"] == 1
+
+
+@pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+def test_full_round_populates_all_protocol_phases():
+    reset_phase_report()
+    service = new_memory_server()
+
+    def new_client():
+        keystore = MemoryKeystore()
+        agent = SdaClient.new_agent(keystore)
+        client = SdaClient(agent, keystore, service)
+        client.upload_agent()
+        return client
+
+    recipient = new_client()
+    recipient_key = recipient.new_encryption_key()
+    recipient.upload_encryption_key(recipient_key)
+    clerks = []
+    for _ in range(3):
+        clerk = new_client()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+        clerks.append(clerk)
+
+    aggregation = Aggregation(
+        id=AggregationId.random(), title="timing", vector_dimension=4, modulus=433,
+        recipient=recipient.agent.id, recipient_key=recipient_key,
+        masking_scheme=FullMasking(433),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    recipient.upload_aggregation(aggregation)
+    recipient.begin_aggregation(aggregation.id)
+    for offset in range(2):
+        new_client().participate([1 + offset, 2, 3, 4], aggregation.id)
+    recipient.end_aggregation(aggregation.id)
+    for clerk in clerks + [recipient]:
+        clerk.run_chores(-1)
+    output = recipient.reveal_aggregation(aggregation.id)
+    np.testing.assert_array_equal(output.positive().values, [3, 4, 6, 8])
+
+    report = phase_report()
+    for phase in (
+        "participant.mask", "participant.share", "participant.encrypt",
+        "server.snapshot_freeze", "server.transpose", "server.enqueue_jobs",
+        "clerk.decrypt", "clerk.combine", "clerk.encrypt",
+        "recipient.combine_masks", "recipient.decrypt_results",
+        "recipient.reconstruct", "recipient.unmask",
+    ):
+        assert phase in report, f"missing phase {phase}"
+        assert report[phase]["count"] >= 1
+    assert report["participant.share"]["count"] == 2  # one per participant
+    assert report["clerk.combine"]["count"] == 3      # one per committee clerk
+
+
+def test_configure_logging_levels():
+    configure_logging(0)
+    assert logging.getLogger().level == logging.WARNING
+    logging.getLogger().setLevel(logging.DEBUG)
+    configure_logging(2)  # basicConfig won't reconfigure, but must not raise
+    logging.getLogger().setLevel(logging.WARNING)
