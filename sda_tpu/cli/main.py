@@ -154,9 +154,10 @@ def main(argv=None) -> int:
                 k = args.secrets_per_batch
                 # Unless the NTT prime equals the aggregation modulus, sums of
                 # masked values must never wrap mod p — pick p with ~21 bits
-                # of headroom over the modulus (≈2M participants), capped by
-                # the 31-bit kernel limit.
-                min_bits = min(args.modulus.bit_length() + 21, 30)
+                # of headroom over the modulus (≈2M participants), capped at
+                # 28 so the generator can land on a Solinas prime (uint32
+                # fast path; hard kernel limit is 31 bits).
+                min_bits = min(args.modulus.bit_length() + 21, 28)
                 t, p, w2, w3 = numtheory.generate_packed_params(
                     k, args.shares, min_modulus_bits=min_bits
                 )

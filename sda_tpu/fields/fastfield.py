@@ -1,0 +1,229 @@
+"""uint32 Solinas-prime field kernels — the TPU fast path.
+
+TPU has no native 64-bit integers: every s64 op XLA emulates costs several
+s32 VPU ops, and s64 arrays burn double HBM bandwidth. The generic kernels
+in ``modular.py`` pay both. This module removes them for primes of Solinas
+form
+
+    p = 2^b - delta,   20 <= b <= 29,   delta < 2^14,
+
+where reduction is shift/add (``2^b ≡ delta (mod p)``) and every
+intermediate provably fits uint32:
+
+- values are canonical residues < p < 2^29 held in uint32 (HALF the bytes);
+- ``v mod p`` for any v < 2^32 is ``q = v >> b; v - q*p`` (+ one
+  conditional subtract), ~3 VPU ops — no 64-bit magic-multiply sequence;
+- products a*b split into 15-bit limbs: 4 uint32 multiplies whose scale
+  streams (2^30, 2^15, 1) recombine through the Solinas congruence with
+  every partial sum < 2^32 (bounds in ``modmatmul32``).
+
+``generate_packed_params`` prefers such primes, so packed-Shamir rounds hit
+this path; arbitrary primes (e.g. the reference's p=433 conformance vector)
+keep the generic ``modular.py`` kernels — results are bit-identical either
+way (tests/test_fastfield.py checks against the NumPy oracle).
+
+Reference semantics being accelerated: the share/clerk/reconstruct loops of
+client/src/crypto/sharing/*.rs (see modular.py / SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U32 = jnp.uint32
+_LOW = 15  # low-limb width: limbs < 2^15 keep 15x15-bit products < 2^30
+
+
+class SolinasPrime:
+    """Parameter pack for p = 2^b - delta; ``try_from`` gates eligibility."""
+
+    __slots__ = ("p", "b", "delta")
+
+    def __init__(self, p: int, b: int, delta: int):
+        self.p = p
+        self.b = b
+        self.delta = delta
+
+    @staticmethod
+    def try_from(p: int) -> Optional["SolinasPrime"]:
+        b = p.bit_length()
+        delta = (1 << b) - p
+        if not (20 <= b <= 29):
+            return None
+        if delta >= (1 << 14):
+            return None
+        # canon32 does ONE conditional subtract after _reduce; its input
+        # r < 2^b + (2^(32-b))*delta must stay < 2p
+        if delta * (1 + (1 << (32 - b))) >= p:
+            return None
+        return SolinasPrime(p, b, delta)
+
+    def __repr__(self):
+        return f"SolinasPrime(2^{self.b} - {self.delta})"
+
+
+def supported(p: int) -> bool:
+    return SolinasPrime.try_from(p) is not None
+
+
+# ---------------------------------------------------------------------------
+# Scalar helpers (all uint32 lanes; sp.* are Python ints => XLA constants)
+
+def _reduce(v, sp: SolinasPrime):
+    """v < 2^32  ->  r ≡ v (mod p), r < p + 8*delta (< 2p)."""
+    q = v >> np.uint32(sp.b)
+    return v - q * np.uint32(sp.p)
+
+
+def canon32(v, sp: SolinasPrime):
+    """v < 2^32 -> canonical residue in [0, p)."""
+    r = _reduce(jnp.asarray(v, _U32), sp)
+    return jnp.where(r >= np.uint32(sp.p), r - np.uint32(sp.p), r)
+
+
+def modadd32(a, b, sp: SolinasPrime):
+    """Canonical a, b -> canonical a+b (sum < 2p < 2^30)."""
+    s = a + b
+    return jnp.where(s >= np.uint32(sp.p), s - np.uint32(sp.p), s)
+
+
+def modsub32(a, b, sp: SolinasPrime):
+    """Canonical a, b -> canonical a-b (uint32 wraparound + correction)."""
+    d = a - b
+    # underflow iff b > a: wrapped value >= 2^32 - p > p, add p back
+    return jnp.where(a >= b, d, d + np.uint32(sp.p))
+
+
+def _compose(t1, t0, sp: SolinasPrime):
+    """t1*2^15 + t0 mod p -> canonical, for t1 < 2^31, t0 < 2^31."""
+    t1 = canon32(t1, sp)                                     # < p < 2^b
+    t1h = t1 >> np.uint32(sp.b - _LOW)                       # < 2^15
+    t1l = t1 & np.uint32((1 << (sp.b - _LOW)) - 1)           # < 2^(b-15)
+    # t1*2^15 = t1h*2^b + t1l*2^15 ≡ t1h*delta + t1l*2^15
+    v = t0 + t1h * np.uint32(sp.delta) + (t1l << np.uint32(_LOW))
+    # bound: 2^31 + 2^29 + 2^29 < 2^32
+    return canon32(v, sp)
+
+
+def mulmod32_const(x, c: int, sp: SolinasPrime):
+    """Canonical x (< p) times Python-int constant c (< p), canonical out."""
+    c = c % sp.p
+    c15 = (c << _LOW) % sp.p
+    xh = x >> np.uint32(_LOW)                                # < 2^(b-15) <= 2^14
+    xl = x & np.uint32((1 << _LOW) - 1)                      # < 2^15
+    # x*c = xh*(c*2^15) + xl*c; split both constants into 15-bit limbs
+    t1 = xh * np.uint32(c15 >> _LOW) + xl * np.uint32(c >> _LOW)   # < 2^30
+    t0 = xh * np.uint32(c15 & 0x7FFF) + xl * np.uint32(c & 0x7FFF)  # < 2^31
+    return _compose(t1, t0, sp)
+
+
+def modsum32(x, sp: SolinasPrime, axis: int = 0):
+    """Canonical residues summed along ``axis`` -> canonical (clerk kernel).
+
+    Tree reduction with a canonicalizing fold every ``fan`` terms, fan
+    chosen so partial sums stay < 2^32 (fan*(p-1) < 2^32).
+    """
+    fan = (0xFFFFFFFF) // (sp.p - 1) if sp.p > 1 else 8
+    fan = max(2, min(256, fan))
+    x = jnp.asarray(x, _U32)
+    x = jnp.moveaxis(x, axis, 0)
+    while x.shape[0] > 1:
+        n = x.shape[0]
+        chunk = min(fan, n)
+        pad = (-n) % chunk
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + x.shape[1:], _U32)], axis=0
+            )
+        x = x.reshape((x.shape[0] // chunk, chunk) + x.shape[1:])
+        x = canon32(jnp.sum(x, axis=1, dtype=_U32), sp)
+    return x[0]
+
+
+def uniform32(key, shape, sp: SolinasPrime):
+    """Uniform canonical residues from 64 random bits per element.
+
+    (hi*2^32 + lo) mod p with exact constant-multiply reduction — same
+    <= p/2^64 statistical distance as the generic uniform_mod.
+    """
+    bits = jax.random.bits(key, shape=tuple(shape) + (2,), dtype=_U32)
+    hi = canon32(bits[..., 0], sp)
+    lo = canon32(bits[..., 1], sp)
+    r32 = (1 << 32) % sp.p
+    return modadd32(mulmod32_const(hi, r32, sp), lo, sp)
+
+
+# ---------------------------------------------------------------------------
+# The contraction kernel: out = (M @ v) mod p, M a small host-side matrix
+
+def modmatmul32(m_host: np.ndarray, v, sp: SolinasPrime):
+    """[n, k] host matrix (ints mod p) times canonical [..., k, B] uint32.
+
+    Limb streams with per-stream overflow-safe fan-in (bounds for b <= 29,
+    low limbs < 2^15, high limbs < 2^(b-15) <= 2^14):
+
+      hh = mh*vh < 2^28   (scale 2^30)    hl/lh = *h**l < 2^29 (scale 2^15)
+      ll = ml*vl < 2^30   (scale 1)
+
+    Each stream folds (canonical reduce) whenever another chunk of terms
+    would overflow uint32; the scale-2^30 stream re-enters through
+    ``mulmod32_const(.., 2^30 mod p)``.
+    """
+    m_host = np.asarray(m_host) % sp.p
+    n, k = m_host.shape
+    v = jnp.asarray(v, _U32)
+    if v.shape[-2] != k:
+        raise ValueError(f"contraction mismatch: M has k={k}, v has {v.shape[-2]}")
+
+    low_mask = (1 << _LOW) - 1
+    mh = jnp.asarray((m_host >> _LOW).astype(np.uint32))     # [n, k] < 2^14
+    ml = jnp.asarray((m_host & low_mask).astype(np.uint32))  # [n, k] < 2^15
+    vh = v >> np.uint32(_LOW)                                # [..., k, B] < 2^14
+    vl = v & np.uint32(low_mask)                             # [..., k, B] < 2^15
+
+    hi_max = (1 << (sp.b - _LOW)) - 1
+    bounds = {
+        "hh": hi_max * hi_max,
+        "hl": hi_max * low_mask,
+        "ll": low_mask * low_mask,
+    }
+    fans = {s: max(1, 0xFFFFFFFF // bound) for s, bound in bounds.items()}
+    # one chunking of the contraction axis serves all streams
+    chunk = max(1, min(fans.values()))
+
+    def stream(a_limbs, b_limbs):
+        # a: [n, k]; b: [..., k, B] -> sum over k of a*b, folded per chunk
+        acc = None
+        for start in range(0, k, chunk):
+            a_c = a_limbs[:, start : start + chunk]          # [n, kc]
+            b_c = b_limbs[..., start : start + chunk, :]     # [..., kc, B]
+            part = jnp.sum(
+                a_c[:, :, None] * b_c[..., None, :, :], axis=-2, dtype=_U32
+            )                                                # [..., n, B]
+            part = canon32(part, sp)
+            acc = part if acc is None else modadd32(acc, part, sp)
+        return acc                                           # canonical < p
+
+    s_hh = stream(mh, vh)
+    s_hl = stream(mh, vl)
+    s_lh = stream(ml, vh)
+    s_ll = stream(ml, vl)
+
+    c30 = (1 << 30) % sp.p
+    t0 = modadd32(s_ll, mulmod32_const(s_hh, c30, sp), sp)   # < p
+    t1 = modadd32(s_hl, s_lh, sp)                            # < p
+    return _compose(t1, t0, sp)                              # t1*2^15 + t0
+
+
+# ---------------------------------------------------------------------------
+# NumPy mirror (oracle for bit-exactness tests)
+
+def np_modmatmul32(m_host: np.ndarray, v: np.ndarray, sp: SolinasPrime) -> np.ndarray:
+    m = np.asarray(m_host, dtype=object) % sp.p
+    vv = np.asarray(v, dtype=object)
+    return (m @ vv % sp.p).astype(np.uint32)

@@ -95,8 +95,23 @@ def next_power(base: int, minimum: int) -> int:
 
 
 def find_prime_with_orders(order2: int, order3: int, min_bits: int = 0) -> int:
-    """Smallest prime p >= 2^min_bits with order2*order3 | p-1 (orders coprime)."""
+    """A prime p >= 2^min_bits with order2*order3 | p-1 (orders coprime).
+
+    Prefers Solinas-form primes (p = 2^b - small delta) so device rounds hit
+    the uint32 fast path (``fields.fastfield``); falls back to the smallest
+    qualifying prime otherwise.
+    """
+    from . import fastfield
+
     step = order2 * order3
+    # p = 2^b - delta >= 2^min_bits needs b > min_bits; fastfield caps b at 29
+    for b in range(max(min_bits + 1, 20), 30):
+        for delta in range(1, 1 << 13):
+            p = (1 << b) - delta
+            if p < (1 << min_bits) or p % step != 1:
+                continue
+            if fastfield.supported(p) and is_prime(p):
+                return p
     c = max(1, ((1 << min_bits) - 1) // step)
     while True:
         p = c * step + 1

@@ -19,6 +19,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from . import fastfield
 from .modular import modmatmul, modsub, modsum, uniform_mod
 
 
@@ -101,6 +102,34 @@ def packed_share(key, secrets, share_matrix, *, prime: int, secret_count: int,
     return packed_share_from_randomness(
         secrets, randomness, share_matrix, prime=prime, secret_count=secret_count
     )
+
+
+# ---------------------------------------------------------------------------
+# uint32 Solinas fast variants (fields.fastfield) — same algebra, same
+# results, ~half the HBM bytes and no emulated-s64 ops. Matrices stay
+# host-side numpy so limb decomposition happens at trace time.
+
+def packed_share32(key, secrets32, share_matrix_host, sp: "fastfield.SolinasPrime",
+                   *, secret_count: int, privacy_threshold: int):
+    """Canonical uint32 [..., d] secrets -> [..., n, B] canonical shares."""
+    d = secrets32.shape[-1]
+    B = -(-d // secret_count)
+    randomness = fastfield.uniform32(
+        key, secrets32.shape[:-1] + (privacy_threshold, B), sp
+    )
+    sk = batch_columns(secrets32, secret_count)                  # [..., k, B]
+    zeros = jnp.zeros(sk.shape[:-2] + (1,) + sk.shape[-1:], sk.dtype)
+    values = jnp.concatenate([zeros, sk, randomness], axis=-2)   # [..., m2, B]
+    return fastfield.modmatmul32(share_matrix_host, values, sp)  # [..., n, B]
+
+
+def packed_reconstruct32(shares32, recon_matrix_host, sp: "fastfield.SolinasPrime",
+                         *, dimension: int):
+    """[r, B] canonical uint32 clerk rows -> [d] canonical secrets."""
+    zeros = jnp.zeros((1,) + shares32.shape[1:], shares32.dtype)
+    values = jnp.concatenate([zeros, shares32], axis=0)          # [r+1, B]
+    secrets = fastfield.modmatmul32(recon_matrix_host, values, sp)
+    return unbatch_columns(secrets, dimension)
 
 
 @functools.partial(jax.jit, static_argnames=("prime", "dimension"))

@@ -42,8 +42,23 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..fields import modular, numtheory, sharing
+from ..fields import fastfield, modular, numtheory, sharing
 from ..utils import timed_phase
+
+
+def _to_residues32(inputs, sp: fastfield.SolinasPrime):
+    """Any-integer inputs -> canonical uint32 residues mod p.
+
+    uint32/int32 non-negative inputs skip the 64-bit pass entirely.
+    """
+    if inputs.dtype == jnp.uint32:
+        return fastfield.canon32(inputs, sp)
+    if inputs.dtype == jnp.int32:
+        bits = inputs.astype(jnp.uint32)  # two's complement: negatives ≡ v + 2^32
+        r = fastfield.canon32(bits, sp)
+        r32 = jnp.uint32((1 << 32) % sp.p)
+        return jnp.where(inputs < 0, fastfield.modsub32(r, r32, sp), r)
+    return jnp.mod(inputs.astype(jnp.int64), sp.p).astype(jnp.uint32)
 from ..protocol import (
     FullMasking,
     LinearMaskingScheme,
@@ -110,19 +125,74 @@ class SimulatedPod:
                 f"by the p axis ({p_shards})"
             )
         s = sharing_scheme
-        self._M = jnp.asarray(numtheory.packed_share_matrix(
+        self._M_host = numtheory.packed_share_matrix(
             s.secret_count, s.share_count, s.privacy_threshold,
             s.prime_modulus, s.omega_secrets, s.omega_shares,
-        ))
-        self._L = jnp.asarray(numtheory.packed_reconstruct_matrix(
+        )
+        self._L_host = numtheory.packed_reconstruct_matrix(
             s.secret_count, s.share_count, s.privacy_threshold,
             s.prime_modulus, s.omega_secrets, s.omega_shares,
             tuple(range(s.share_count)),
-        ))
+        )
+        self._M = jnp.asarray(self._M_host)
+        self._L = jnp.asarray(self._L_host)
+        # uint32 fast path: Solinas prime AND cross-shard sums can't wrap u32
+        sp = fastfield.SolinasPrime.try_from(s.prime_modulus)
+        if sp is not None and p_shards * (s.prime_modulus - 1) >= (1 << 32):
+            sp = None
+        self._sp = sp
         self._step = None
         self._step_shape = None
 
     # ------------------------------------------------------------------
+    def _local_round_fast(self, inputs, key):
+        """uint32 Solinas body under shard_map: inputs [P_loc, d_loc].
+
+        Identical dataflow to ``_local_round`` (same collectives over the
+        same axes) with all field math on the fast path; cross-shard sums
+        ride the collectives in uint32 (bounded: p_shards * (p-1) < 2^32,
+        checked in __init__) and are canonicalized on arrival.
+        """
+        s = self.scheme
+        sp = self._sp
+        P_loc, d_loc = inputs.shape
+        pi = jax.lax.axis_index("p")
+        di = jax.lax.axis_index("d")
+        key = jax.random.fold_in(jax.random.fold_in(key, pi), di)
+
+        x = _to_residues32(inputs, sp)
+        if isinstance(self.masking, FullMasking):
+            mkey, skey = jax.random.split(key)
+            masks = fastfield.uniform32(mkey, (P_loc, d_loc), sp)
+            masked = fastfield.modadd32(x, masks, sp)
+            local_mask_sum = fastfield.modsum32(masks, sp, axis=0)     # [d_loc]
+        else:
+            skey = key
+            masked = x
+            local_mask_sum = None
+
+        shares = sharing.packed_share32(
+            skey, masked, self._M_host, sp,
+            secret_count=s.secret_count, privacy_threshold=s.privacy_threshold,
+        )                                                              # [P_loc, n, B_loc]
+        local_sum = fastfield.modsum32(shares, sp, axis=0)             # [n, B_loc]
+
+        clerk_rows = jax.lax.psum_scatter(
+            local_sum, "p", scatter_dimension=0, tiled=True
+        )                                                              # [n/p, B_loc]
+        clerk_rows = fastfield.canon32(clerk_rows, sp)
+
+        gathered = jax.lax.all_gather(clerk_rows, "p", axis=0, tiled=True)
+
+        masked_total = sharing.packed_reconstruct32(
+            gathered, self._L_host, sp, dimension=d_loc
+        )                                                              # [d_loc]
+
+        if local_mask_sum is None:
+            return masked_total.astype(jnp.int64)
+        mask_total = fastfield.canon32(jax.lax.psum(local_mask_sum, "p"), sp)
+        return fastfield.modsub32(masked_total, mask_total, sp).astype(jnp.int64)
+
     def _local_round(self, inputs, key):
         """Per-device body under shard_map: inputs [P_loc, d_loc]."""
         s = self.scheme
@@ -191,7 +261,7 @@ class SimulatedPod:
                 f"= {s.secret_count * d_shards}"
             )
         fn = jax.shard_map(
-            self._local_round,
+            self._local_round_fast if self._sp is not None else self._local_round,
             mesh=self.mesh,
             in_specs=(P("p", "d"), P()),
             out_specs=P("d"),
@@ -232,7 +302,9 @@ def single_chip_round(
 
     Same algebra as SimulatedPod (mask -> share -> combine -> reconstruct ->
     unmask) with the committee resident on a single chip — the flagship
-    single-chip "forward step" and the unit benchmark kernel.
+    single-chip "forward step" and the unit benchmark kernel. For Solinas
+    primes (the generator's preference) the whole round runs on the uint32
+    fast path (fields.fastfield); results are bit-identical either way.
     """
     s = sharing_scheme
     masking = masking_scheme or NoMasking()
@@ -240,14 +312,46 @@ def single_chip_round(
         raise ValueError("single_chip_round masking: None or Full")
     _check_mask_modulus(masking, s)
     p = s.prime_modulus
-    M = jnp.asarray(numtheory.packed_share_matrix(
+    M_host = numtheory.packed_share_matrix(
         s.secret_count, s.share_count, s.privacy_threshold,
         p, s.omega_secrets, s.omega_shares,
-    ))
-    L = jnp.asarray(numtheory.packed_reconstruct_matrix(
+    )
+    L_host = numtheory.packed_reconstruct_matrix(
         s.secret_count, s.share_count, s.privacy_threshold,
         p, s.omega_secrets, s.omega_shares, tuple(range(s.share_count)),
-    ))
+    )
+
+    sp = fastfield.SolinasPrime.try_from(p)
+    if sp is not None:
+
+        def round_fn(inputs, key):
+            P_total, d = inputs.shape
+            x = _to_residues32(inputs, sp)
+            if isinstance(masking, FullMasking):
+                mkey, skey = jax.random.split(key)
+                masks = fastfield.uniform32(mkey, (P_total, d), sp)
+                masked = fastfield.modadd32(x, masks, sp)
+                mask_total = fastfield.modsum32(masks, sp, axis=0)
+            else:
+                skey = key
+                masked = x
+                mask_total = None
+            shares = sharing.packed_share32(
+                skey, masked, M_host, sp,
+                secret_count=s.secret_count, privacy_threshold=s.privacy_threshold,
+            )                                                  # [P, n, B]
+            combined = fastfield.modsum32(shares, sp, axis=0)  # clerk combine
+            masked_total = sharing.packed_reconstruct32(
+                combined, L_host, sp, dimension=d
+            )
+            if mask_total is None:
+                return masked_total.astype(jnp.int64)
+            return fastfield.modsub32(masked_total, mask_total, sp).astype(jnp.int64)
+
+        return round_fn
+
+    M = jnp.asarray(M_host)
+    L = jnp.asarray(L_host)
 
     def round_fn(inputs, key):
         P_total, d = inputs.shape

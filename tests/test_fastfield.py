@@ -1,0 +1,119 @@
+"""Bit-exactness of the uint32 Solinas fast path vs exact integer math.
+
+Every kernel in sda_tpu.fields.fastfield must agree with Python big-int
+arithmetic on worst-case operands; the fast path may only change speed,
+never results (SURVEY.md §2.2 oracle discipline).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sda_tpu.fields import fastfield as ff
+from sda_tpu.fields import numtheory
+
+P29 = 536870233   # 2^29 - 679, ≡ 1 mod 72
+P28 = 268435009   # 2^28 - 447, ≡ 1 mod 72
+
+
+@pytest.mark.parametrize("p,expected", [
+    (P29, True),
+    (P28, True),
+    (433, False),                  # too small
+    ((1 << 30) + 3, False),        # b = 31 > 29
+    ((1 << 29) - (1 << 15), False) # delta too large
+])
+def test_try_from_gating(p, expected):
+    assert (ff.SolinasPrime.try_from(p) is not None) == expected
+    assert ff.supported(p) == expected
+
+
+@pytest.fixture(params=[P29, P28])
+def sp(request):
+    return ff.SolinasPrime.try_from(request.param)
+
+
+def test_canon32_full_range(sp):
+    rng = np.random.default_rng(0)
+    p = sp.p
+    v = np.concatenate([
+        rng.integers(0, 1 << 32, size=20000, dtype=np.uint64).astype(np.uint32),
+        np.array([0, 1, p - 1, p, p + 1, 2**32 - 1, 2**31, 2**30], dtype=np.uint32),
+    ])
+    got = np.asarray(ff.canon32(jnp.asarray(v), sp))
+    np.testing.assert_array_equal(got.astype(object), v.astype(object) % p)
+
+
+def test_addsub_mulconst(sp):
+    rng = np.random.default_rng(1)
+    p = sp.p
+    a = rng.integers(0, p, size=20000).astype(np.uint32)
+    b = rng.integers(0, p, size=20000).astype(np.uint32)
+    np.testing.assert_array_equal(
+        np.asarray(ff.modadd32(jnp.asarray(a), jnp.asarray(b), sp)).astype(object),
+        (a.astype(object) + b) % p,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ff.modsub32(jnp.asarray(a), jnp.asarray(b), sp)).astype(object),
+        (a.astype(object) - b) % p,
+    )
+    for c in (0, 1, p - 1, 12345, (1 << 30) % p, (1 << 32) % p):
+        got = np.asarray(ff.mulmod32_const(jnp.asarray(a), c, sp))
+        np.testing.assert_array_equal(got.astype(object), a.astype(object) * c % p)
+
+
+def test_modsum32(sp):
+    rng = np.random.default_rng(2)
+    p = sp.p
+    # worst case: all terms p-1, count straddling the fold fan-in
+    for n in (1, 2, 7, 8, 9, 100, 1000):
+        x = np.full((n, 33), p - 1, dtype=np.uint32)
+        got = np.asarray(ff.modsum32(jnp.asarray(x), sp, axis=0))
+        np.testing.assert_array_equal(got.astype(object), (n * (p - 1)) % p)
+    x = rng.integers(0, p, size=(321, 50)).astype(np.uint32)
+    got = np.asarray(ff.modsum32(jnp.asarray(x), sp, axis=0))
+    np.testing.assert_array_equal(got.astype(object), x.astype(object).sum(0) % p)
+
+
+def test_modmatmul32_worst_case(sp):
+    rng = np.random.default_rng(3)
+    p = sp.p
+    for (n, k, B) in [(8, 8, 257), (3, 9, 130), (16, 16, 64), (1, 1, 8)]:
+        M = rng.integers(0, p, size=(n, k))
+        M[:, : min(2, k)] = p - 1
+        V = rng.integers(0, p, size=(k, B)).astype(np.uint32)
+        V[:, : min(5, B)] = p - 1
+        got = np.asarray(ff.modmatmul32(M, jnp.asarray(V), sp))
+        exp = (M.astype(object) @ V.astype(object)) % p
+        np.testing.assert_array_equal(got.astype(object), exp)
+
+
+def test_modmatmul32_batched(sp):
+    rng = np.random.default_rng(4)
+    p = sp.p
+    M = rng.integers(0, p, size=(8, 8))
+    V = rng.integers(0, p, size=(5, 8, 33)).astype(np.uint32)
+    got = np.asarray(ff.modmatmul32(M, jnp.asarray(V), sp))
+    exp = np.stack([
+        (M.astype(object) @ V[i].astype(object)) % p for i in range(V.shape[0])
+    ])
+    np.testing.assert_array_equal(got.astype(object), exp)
+
+
+def test_uniform32_range_and_mean(sp):
+    u = np.asarray(ff.uniform32(jax.random.PRNGKey(7), (100000,), sp))
+    assert u.dtype == np.uint32
+    assert int(u.max()) < sp.p
+    assert abs(u.mean() / sp.p - 0.5) < 0.01
+
+
+def test_generated_packed_params_prefer_solinas():
+    """The default prime generator should land on fast-path primes when a
+    Solinas candidate exists in range (so flagship rounds use uint32)."""
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    assert ff.supported(p), f"generated prime {p} misses the fast path"
+    numtheory.validate_packed_scheme(3, 8, t, p, w2, w3)
+    # out-of-range request still produces a valid (generic-path) scheme
+    t2, p2, _, _ = numtheory.generate_packed_params(3, 8, 30)
+    assert p2 >= (1 << 30) and numtheory.is_prime(p2)
