@@ -1,3 +1,8 @@
 """TPU-native simulated-pod execution over a device mesh."""
 
 from .simpod import SimulatedPod, default_mesh_shape, make_mesh, single_chip_round
+from .streaming import (
+    StreamingAggregator,
+    array_block_provider,
+    synthetic_block_provider,
+)

@@ -1,0 +1,237 @@
+"""Streamed secure-aggregation rounds for workloads larger than HBM.
+
+SURVEY.md §7 hard part (f): the flagship configs (10k participants x
+10M-dim vectors) cannot materialize [P, d] on one chip, let alone the
+[P, n, B] share tensor. But the whole pipeline is a sum over participants
+of per-participant shares, so it streams: tile the participant axis and
+the dimension axis, push each [P_chunk, d_chunk] block through
+mask -> share -> local combine on device, and fold it into running
+[n, B_chunk] share and [d_chunk] mask accumulators. Peak memory is one
+block plus accumulators, independent of P. Per dim-tile, reconstruction
+and unmasking run once at the end.
+
+The reference reaches the same scale by chunking vectors into
+secret_count-sized batches and streaming participations through the server
+one HTTP upload at a time (client/src/crypto/sharing/batched.rs:18-53,
+server/src/snapshot.rs); here the chunk loop is a host-side driver around
+jitted device steps (at most two compiled shapes per axis: full chunk and
+remainder), with the uint32 Solinas fast path when the prime qualifies.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields import fastfield, modular, numtheory, sharing
+from ..protocol import (
+    FullMasking,
+    LinearMaskingScheme,
+    NoMasking,
+    PackedShamirSharing,
+)
+from .simpod import _check_mask_modulus, _to_residues32
+
+#: get_block(p0, p1, d0, d1) -> integer array [p1-p0, d1-d0]
+BlockProvider = Callable[[int, int, int, int], np.ndarray]
+
+
+def array_block_provider(inputs) -> BlockProvider:
+    """Adapt an in-memory (or np.memmap) [P, d] array to a BlockProvider."""
+
+    def get_block(p0, p1, d0, d1):
+        return inputs[p0:p1, d0:d1]
+
+    return get_block
+
+
+def synthetic_block_provider(
+    modulus: int, seed: int = 0, max_value: Optional[int] = None
+) -> BlockProvider:
+    """Deterministic pseudo-random blocks without materializing [P, d] —
+    benchmark-scale inputs. Each element is a splitmix64-style hash of its
+    absolute (participant, component) coordinates, so every tiling reads
+    the same virtual matrix."""
+    bound = np.uint64(max_value if max_value is not None else modulus)
+    s = np.uint64(seed * 0x9E3779B97F4A7C15 & 0xFFFFFFFFFFFFFFFF)
+
+    def _mix(z):
+        z = (z + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+    def get_block(p0, p1, d0, d1):
+        with np.errstate(over="ignore"):
+            rows = _mix(np.arange(p0, p1, dtype=np.uint64)[:, None] + s)
+            cols = _mix(np.arange(d0, d1, dtype=np.uint64)[None, :] ^ s)
+            vals = _mix(rows ^ cols)
+        return (vals % bound).astype(np.int64)
+
+    return get_block
+
+
+class StreamingAggregator:
+    """Chunked single-chip rounds: fixed device memory for any P and d."""
+
+    def __init__(
+        self,
+        sharing_scheme: PackedShamirSharing,
+        masking_scheme: Optional[LinearMaskingScheme] = None,
+        participants_chunk: int = 64,
+        dim_chunk: int = 3 * (1 << 20),
+    ):
+        if not isinstance(sharing_scheme, PackedShamirSharing):
+            raise ValueError("StreamingAggregator runs Packed-Shamir rounds")
+        self.scheme = s = sharing_scheme
+        self.masking = masking_scheme or NoMasking()
+        if not isinstance(self.masking, (NoMasking, FullMasking)):
+            raise ValueError("streaming masking: None or Full (seed PRGs are host-side)")
+        _check_mask_modulus(self.masking, s)
+        if dim_chunk % s.secret_count:
+            raise ValueError(
+                f"dim_chunk {dim_chunk} must be divisible by secret_count "
+                f"{s.secret_count}"
+            )
+        self.participants_chunk = int(participants_chunk)
+        self.dim_chunk = int(dim_chunk)
+        self._M_host = numtheory.packed_share_matrix(
+            s.secret_count, s.share_count, s.privacy_threshold,
+            s.prime_modulus, s.omega_secrets, s.omega_shares,
+        )
+        self._L_host = numtheory.packed_reconstruct_matrix(
+            s.secret_count, s.share_count, s.privacy_threshold,
+            s.prime_modulus, s.omega_secrets, s.omega_shares,
+            tuple(range(s.share_count)),
+        )
+        self._sp = fastfield.SolinasPrime.try_from(s.prime_modulus)
+        self._steps = {}      # block shape -> jitted accumulate step
+        self._finals = {}     # dim size -> jitted reconstruct+unmask
+
+    # -- jitted pieces ---------------------------------------------------
+    def _step_fn(self, block_shape):
+        s, sp, mask = self.scheme, self._sp, isinstance(self.masking, FullMasking)
+        p = s.prime_modulus
+        M_host = self._M_host
+
+        if sp is not None:
+
+            def step(block, key, acc_shares, acc_mask):
+                x = _to_residues32(block, sp)
+                if mask:
+                    mkey, skey = jax.random.split(key)
+                    masks = fastfield.uniform32(mkey, block.shape, sp)
+                    masked = fastfield.modadd32(x, masks, sp)
+                    acc_mask = fastfield.modadd32(
+                        acc_mask, fastfield.modsum32(masks, sp, axis=0), sp
+                    )
+                else:
+                    skey = key
+                    masked = x
+                shares = sharing.packed_share32(
+                    skey, masked, M_host, sp,
+                    secret_count=s.secret_count,
+                    privacy_threshold=s.privacy_threshold,
+                )
+                acc_shares = fastfield.modadd32(
+                    acc_shares, fastfield.modsum32(shares, sp, axis=0), sp
+                )
+                return acc_shares, acc_mask
+
+        else:
+            M = jnp.asarray(M_host)
+
+            def step(block, key, acc_shares, acc_mask):
+                x = modular.canon(block.astype(jnp.int64), p)
+                if mask:
+                    mkey, skey = jax.random.split(key)
+                    masks = modular.uniform_mod(mkey, block.shape, p)
+                    masked = modular.modadd(x, masks, p)
+                    acc_mask = modular.modadd(
+                        acc_mask, modular.modsum(masks, p, axis=0), p
+                    )
+                else:
+                    skey = key
+                    masked = x
+                shares = sharing.packed_share(
+                    skey, masked, M,
+                    prime=p, secret_count=s.secret_count,
+                    privacy_threshold=s.privacy_threshold,
+                )
+                acc_shares = modular.modadd(
+                    acc_shares, modular.modsum(shares, p, axis=0), p
+                )
+                return acc_shares, acc_mask
+
+        return jax.jit(step, donate_argnums=(2, 3))
+
+    def _final_fn(self, d_size):
+        s, sp = self.scheme, self._sp
+        p = s.prime_modulus
+        mask = isinstance(self.masking, FullMasking)
+        L_host = self._L_host
+
+        if sp is not None:
+
+            def final(acc_shares, acc_mask):
+                total = sharing.packed_reconstruct32(
+                    acc_shares, L_host, sp, dimension=d_size
+                )
+                if mask:
+                    total = fastfield.modsub32(total, acc_mask, sp)
+                return total.astype(jnp.int64)
+
+        else:
+            L = jnp.asarray(L_host)
+
+            def final(acc_shares, acc_mask):
+                total = sharing.packed_reconstruct(
+                    acc_shares, L, prime=p, dimension=d_size
+                )
+                if mask:
+                    total = modular.modsub(total, acc_mask, p)
+                return total
+
+        return jax.jit(final, donate_argnums=(0, 1))
+
+    # -- driver ----------------------------------------------------------
+    def aggregate_blocks(
+        self, get_block: BlockProvider, participants: int, dimension: int, key=None
+    ) -> np.ndarray:
+        """Stream all blocks; returns the [dimension] aggregate (host array)."""
+        s = self.scheme
+        p = s.prime_modulus
+        if key is None:
+            from ..crypto.core import fresh_prng_key
+
+            key = fresh_prng_key()
+        acc_dtype = jnp.uint32 if self._sp is not None else jnp.int64
+        out = np.empty(dimension, dtype=np.int64)
+        for di, d0 in enumerate(range(0, dimension, self.dim_chunk)):
+            d1 = min(d0 + self.dim_chunk, dimension)
+            d_size = d1 - d0
+            B = -(-d_size // s.secret_count)
+            acc_shares = jnp.zeros((s.share_count, B), acc_dtype)
+            acc_mask = jnp.zeros((d_size,), acc_dtype)
+            for pi, p0 in enumerate(range(0, participants, self.participants_chunk)):
+                p1 = min(p0 + self.participants_chunk, participants)
+                block = jnp.asarray(np.asarray(get_block(p0, p1, d0, d1)))
+                bkey = jax.random.fold_in(jax.random.fold_in(key, pi), di)
+                step = self._steps.get(block.shape)
+                if step is None:
+                    step = self._steps[block.shape] = self._step_fn(block.shape)
+                acc_shares, acc_mask = step(block, bkey, acc_shares, acc_mask)
+            final = self._finals.get(d_size)
+            if final is None:
+                final = self._finals[d_size] = self._final_fn(d_size)
+            out[d0:d1] = np.asarray(final(acc_shares, acc_mask))
+        return out
+
+    def aggregate(self, inputs, key=None) -> np.ndarray:
+        inputs = np.asarray(inputs)
+        return self.aggregate_blocks(
+            array_block_provider(inputs), inputs.shape[0], inputs.shape[1], key
+        )
