@@ -1,0 +1,247 @@
+"""Benchmark suite over the five BASELINE.json configs.
+
+The reference publishes no numbers (BASELINE.md), so this suite CREATES the
+baseline: shared-elements/sec/chip for each config, with per-phase wall
+times where they are measurable. Run on the real chip:
+
+    python benchmarks/suite.py                  # all configs
+    SDA_BENCH_CONFIGS=packed-1m,lenet-60k python benchmarks/suite.py
+    SDA_BENCH_MAX_SECONDS=30 python benchmarks/suite.py   # streaming budget
+
+Each config prints one JSON line; the full set is also written to
+BENCH_SUITE.json. Configs (BASELINE.json "configs"):
+
+1. readme-walkthrough — additive 3-way, dim 10, mod 433, 3 participants,
+   REAL protocol stack (crypto + in-process server), asserting the
+   reference walkthrough's exact output semantics.
+2. packed-1m        — Packed-Shamir 1M-dim x 100 participants x 8 clerks.
+3. lenet-60k        — ~60K params x 1000 participants (FedAvg LeNet).
+4. mobilenet-3.5m   — ~3.5M params x 5000 participants (edge flagship),
+   streamed (does not fit HBM at once).
+5. lora-13m         — ~13M params x 10k participants (Llama LoRA-r16),
+   streamed; with a time budget the suite reports measured coverage
+   honestly rather than extrapolating silently.
+
+Throughput metric: participants x dimension / round-time = input elements
+pushed through the complete mask->share->combine->reconstruct->unmask
+pipeline (every field op the reference spreads across its Rust loops).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _scheme(bits=28):
+    from sda_tpu.fields import numtheory
+    from sda_tpu.protocol import PackedShamirSharing
+
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, bits)
+    return PackedShamirSharing(3, 8, t, p, w2, w3)
+
+
+def bench_readme_walkthrough():
+    """Config 1: the reference CLI walkthrough, real crypto + broker."""
+    import jax
+    from sda_tpu.client import SdaClient
+    from sda_tpu.crypto import MemoryKeystore
+    from sda_tpu.protocol import (
+        AdditiveSharing, Aggregation, AggregationId, NoMasking, SodiumEncryption,
+    )
+    from sda_tpu.server import new_memory_server
+    from sda_tpu.utils import phase_report, reset_phase_report
+
+    service = new_memory_server()
+
+    def new_client():
+        ks = MemoryKeystore()
+        c = SdaClient(SdaClient.new_agent(ks), ks, service)
+        c.upload_agent()
+        return c
+
+    recipient = new_client()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    clerks = [new_client() for _ in range(3)]
+    for c in clerks:
+        c.upload_encryption_key(c.new_encryption_key())
+
+    dim, mod, participants = 10, 433, 3
+    reset_phase_report()
+    start = time.perf_counter()
+    agg = Aggregation(
+        id=AggregationId.random(), title="walkthrough", vector_dimension=dim,
+        modulus=mod, recipient=recipient.agent.id, recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=mod),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id)
+    for i in range(participants):
+        new_client().participate([(i + j) % mod for j in range(dim)], agg.id)
+    recipient.end_aggregation(agg.id)
+    for c in clerks + [recipient]:
+        c.run_chores(-1)
+    output = recipient.reveal_aggregation(agg.id).positive()
+    elapsed = time.perf_counter() - start
+
+    expected = [sum((i + j) % mod for i in range(participants)) % mod
+                for j in range(dim)]
+    np.testing.assert_array_equal(output.values, expected)
+    return {
+        "config": "readme-walkthrough",
+        "metric": "full protocol round latency (3 participants, 3 clerks, dim 10)",
+        "value": round(elapsed, 4),
+        "unit": "seconds",
+        "elements_per_sec": round(participants * dim / elapsed, 1),
+        "phases": {k: round(v["total_s"], 4) for k, v in phase_report().items()},
+    }
+
+
+def _round_bench(name, participants, dim, reps=3):
+    """Single-chip full-round throughput (configs 2 and 3)."""
+    import jax
+    import jax.numpy as jnp
+    from sda_tpu.mesh import single_chip_round
+    from sda_tpu.protocol import FullMasking
+
+    scheme = _scheme()
+    p = scheme.prime_modulus
+    fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
+    rng = np.random.default_rng(0)
+    inputs = jnp.asarray(
+        rng.integers(0, 1 << 20, size=(participants, dim), dtype=np.int64)
+    )
+    key = jax.random.PRNGKey(0)
+    out = fn(inputs, key)
+    out.block_until_ready()
+    times = []
+    for i in range(reps):
+        k = jax.random.fold_in(key, i)
+        st = time.perf_counter()
+        fn(inputs, k).block_until_ready()
+        times.append(time.perf_counter() - st)
+    best = min(times)
+    # exactness spot check
+    np.testing.assert_array_equal(
+        np.asarray(out[:1024]),
+        np.asarray(inputs[:, :1024]).sum(axis=0) % p,
+    )
+    return {
+        "config": name,
+        "metric": f"secure-aggregation throughput ({participants} x {dim}, "
+                  f"Packed-Shamir n=8, full mask)",
+        "value": round(participants * dim / best, 1),
+        "unit": "shared-elements/sec/chip",
+        "round_seconds": round(best, 4),
+    }
+
+
+def _streaming_bench(name, participants, dim, max_seconds):
+    """Streamed throughput (configs 4 and 5): measure steady-state chunk
+    rate within a time budget; report coverage, never extrapolate silently."""
+    import jax
+    from sda_tpu.mesh import StreamingAggregator, synthetic_block_provider
+    from sda_tpu.protocol import FullMasking
+
+    scheme = _scheme()
+    p = scheme.prime_modulus
+    pc = int(os.environ.get("SDA_BENCH_PART_CHUNK", 64))
+    dc_default = 3 * (1 << 19) if dim > 3 * (1 << 19) else dim
+    dc = int(os.environ.get("SDA_BENCH_DIM_CHUNK", dc_default))
+    agg = StreamingAggregator(
+        scheme, FullMasking(p), participants_chunk=pc, dim_chunk=dc
+    )
+    prov = synthetic_block_provider(p, seed=3, max_value=1 << 20)
+    key = jax.random.PRNGKey(0)
+
+    # exactness spot check on a tiny sub-problem, then the timed chunk loop
+    sub = agg.aggregate_blocks(prov, 2 * pc, min(dim, 3 * 64), key)
+    exp = prov(0, 2 * pc, 0, min(dim, 3 * 64)).sum(axis=0) % p
+    np.testing.assert_array_equal(sub, exp)
+
+    import jax.numpy as jnp
+
+    dim_covered = min(dim, dc)
+    s = agg.scheme
+    B = -(-dim_covered // s.secret_count)
+    acc_dtype = jnp.uint32 if agg._sp is not None else jnp.int64
+    acc_shares = jnp.zeros((s.share_count, B), acc_dtype)
+    acc_mask = jnp.zeros((dim_covered,), acc_dtype)
+    step = agg._step_fn((pc, dim_covered))
+
+    # host blocks pre-generated and rotated so numpy hashing stays out of
+    # the timed span (H2D transfer remains in it); warm-up compiles the step
+    host_blocks = [prov(i * pc, (i + 1) * pc, 0, dim_covered) for i in range(4)]
+    warm = step(jnp.asarray(host_blocks[0]), key,
+                jnp.zeros_like(acc_shares), jnp.zeros_like(acc_mask))
+    jax.block_until_ready(warm)
+
+    start = time.perf_counter()
+    pi = 0
+    while True:
+        p0 = pi * pc
+        if p0 + pc > participants:
+            break
+        block = jnp.asarray(host_blocks[pi % len(host_blocks)])
+        bkey = jax.random.fold_in(key, pi)
+        acc_shares, acc_mask = step(block, bkey, acc_shares, acc_mask)
+        pi += 1
+        if pi % 4 == 0:
+            jax.block_until_ready(acc_shares)
+            if time.perf_counter() - start > max_seconds:
+                break
+    jax.block_until_ready(acc_shares)
+    elapsed = time.perf_counter() - start
+    done_participants = pi * pc
+    elements = done_participants * dim_covered
+    coverage = elements / (participants * dim)
+    return {
+        "config": name,
+        "metric": f"streamed secure-aggregation throughput "
+                  f"(target {participants} x {dim}, chunk {pc} x {dim_covered})",
+        "value": round(elements / elapsed, 1),
+        "unit": "shared-elements/sec/chip",
+        "measured_seconds": round(elapsed, 2),
+        "measured_fraction_of_full_workload": round(coverage, 4),
+    }
+
+
+CONFIGS = {
+    "readme-walkthrough": lambda: bench_readme_walkthrough(),
+    "packed-1m": lambda: _round_bench("packed-1m", 100, 999_999),
+    "lenet-60k": lambda: _round_bench("lenet-60k", 1000, 59_999),
+    "mobilenet-3.5m": lambda: _streaming_bench(
+        "mobilenet-3.5m", 5000, 3_499_999,
+        float(os.environ.get("SDA_BENCH_MAX_SECONDS", 60))),
+    "lora-13m": lambda: _streaming_bench(
+        "lora-13m", 10_000, 12_999_999,
+        float(os.environ.get("SDA_BENCH_MAX_SECONDS", 60))),
+}
+
+
+def main():
+    wanted = os.environ.get("SDA_BENCH_CONFIGS")
+    names = wanted.split(",") if wanted else list(CONFIGS)
+    results = []
+    for name in names:
+        result = CONFIGS[name.strip()]()
+        results.append(result)
+        print(json.dumps(result), flush=True)
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_SUITE.json")
+    with open(out_path, "w") as f:
+        json.dump({"results": results}, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
