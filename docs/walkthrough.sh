@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# End-to-end walkthrough of sda-tpu's CLIs: one server, a recipient, three
+# clerks, three participants, additive 3-way sharing of 10-dim mod-433
+# vectors. Expected final reveal: 0 2 2 4 4 6 6 8 8 10
+# (the reference walkthrough's config and output: SURVEY.md §6).
+#
+# Usage:  bash docs/walkthrough.sh   (from the repo root; needs libsodium)
+set -euo pipefail
+
+WORK=$(mktemp -d)
+trap 'kill $SERVER_PID 2>/dev/null || true; rm -rf "$WORK"' EXIT
+PORT=$(( (RANDOM % 10000) + 20000 ))
+URL="http://127.0.0.1:$PORT"
+
+echo "== starting sdad (sqlite store) on $URL"
+python -m sda_tpu.cli.serverd --sqlite "$WORK/server.db" httpd --bind "127.0.0.1:$PORT" &
+SERVER_PID=$!
+for _ in $(seq 50); do
+  python -m sda_tpu.cli.main -s "$URL" -i "$WORK/probe" ping >/dev/null 2>&1 && break
+  sleep 0.2
+done
+
+sda() { local who=$1; shift; python -m sda_tpu.cli.main -s "$URL" -i "$WORK/$who" "$@"; }
+
+echo "== recipient + clerks register and publish encryption keys"
+sda recipient agent create
+sda recipient agent keys create
+for c in clerk-1 clerk-2 clerk-3; do
+  sda "$c" agent create
+  sda "$c" agent keys create
+done
+
+echo "== recipient creates and opens the aggregation"
+AGG=$(sda recipient aggregations create demo --dimension 10 --modulus 433 \
+        --sharing add --shares 3)
+sda recipient aggregations begin "$AGG"
+
+echo "== three participants submit masked, shared inputs"
+sda participant-1 agent create
+sda participant-1 participate "$AGG" 0 0 0 1 1 1 2 2 2 3
+sda participant-2 agent create
+sda participant-2 participate "$AGG" 0 1 1 1 1 2 2 3 3 3
+sda participant-3 agent create
+sda participant-3 participate "$AGG" 0 1 1 2 2 3 2 3 3 4
+
+echo "== recipient closes the round; committee members process their jobs"
+sda recipient aggregations end "$AGG"
+# the recipient owns a key too, so it may itself be elected to the committee
+for c in clerk-1 clerk-2 clerk-3 recipient; do
+  sda "$c" clerk --once
+done
+
+echo "== final reveal"
+sda recipient aggregations reveal "$AGG"
