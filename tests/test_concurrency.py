@@ -1,0 +1,163 @@
+"""Thread-safety stress tests for the server stores.
+
+The reference leans on Rust's ownership model (Arc + Send/Sync bounds,
+SURVEY.md §5.2) and has no race tests at all. Here the broker is Python:
+these tests hammer the mutable store paths from many threads — concurrent
+participation uploads racing a snapshot, concurrent clerk result uploads,
+concurrent agent registration — and assert the invariants the protocol
+depends on: a snapshot freezes a consistent participation set, every job
+is answered exactly once, nothing is lost or double-counted.
+"""
+
+import threading
+
+import pytest
+
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    NoMasking,
+    Participation,
+    ParticipationId,
+    Snapshot,
+    SnapshotId,
+    SodiumEncryption,
+)
+from sda_tpu.server import new_memory_server, new_sqlite_server
+
+from util import mock_encryption, new_agent, new_full_agent
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def service(request, tmp_path):
+    if request.param == "memory":
+        return new_memory_server()
+    return new_sqlite_server(tmp_path / "sda.db")
+
+
+def _world(service, clerks=3):
+    recipient, recipient_key = new_full_agent(service)
+    committee = [new_full_agent(service) for _ in range(clerks)]
+    agg = Aggregation(
+        id=AggregationId.random(), title="stress", vector_dimension=4, modulus=433,
+        recipient=recipient.id, recipient_key=recipient_key.body.id,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=clerks, modulus=433),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    service.create_aggregation(recipient, agg)
+    from sda_tpu.protocol import Committee
+
+    service.create_committee(recipient, Committee(
+        aggregation=agg.id,
+        clerks_and_keys=[(a.id, k.body.id) for (a, k) in committee],
+    ))
+    return recipient, committee, agg
+
+
+def _participate(service, agg, clerks):
+    agent = new_agent()
+    service.create_agent(agent, agent)
+    participation = Participation(
+        id=ParticipationId.random(), participant=agent.id, aggregation=agg.id,
+        recipient_encryption=None,
+        clerk_encryptions=[(a.id, mock_encryption(b"x")) for (a, _) in clerks],
+    )
+    service.create_participation(agent, participation)
+
+
+def test_concurrent_participations_race_snapshot(service):
+    """60 participations from 6 threads racing one snapshot: the frozen set
+    must be a consistent subset and the total count must end exact."""
+    recipient, committee, agg = _world(service)
+    clerks = [c for (c, _) in committee]
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(10):
+                _participate(service, agg, committee)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    snap = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+    service.create_snapshot(recipient, snap)
+    for t in threads:
+        t.join()
+    assert not errors
+
+    status = service.get_aggregation_status(recipient, agg.id)
+    assert status.number_of_participations == 60
+
+    # the frozen set: every clerk job carries exactly the same count, and
+    # that count can't exceed the final total
+    jobs = [service.get_clerking_job(clerk, clerk.id) for clerk in clerks]
+    jobs = [j for j in jobs if j is not None]
+    assert jobs, "snapshot must have enqueued clerk jobs"
+    sizes = {len(j.encryptions) for j in jobs}
+    assert len(sizes) == 1, f"clerks saw inconsistent frozen sets: {sizes}"
+    assert 0 <= sizes.pop() <= 60
+
+
+def test_concurrent_clerk_results_exactly_once(service):
+    """All clerks upload concurrently (with duplicates): every job ends
+    done exactly once and the snapshot's results are complete."""
+    from sda_tpu.protocol import ClerkingResult
+
+    recipient, committee, agg = _world(service, clerks=8)
+    for _ in range(5):
+        _participate(service, agg, committee)
+    snap = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+    service.create_snapshot(recipient, snap)
+
+    errors = []
+
+    def clerk_worker(agent):
+        try:
+            job = service.get_clerking_job(agent, agent.id)
+            result = ClerkingResult(
+                job=job.id, clerk=agent.id, encryption=mock_encryption(b"sum")
+            )
+            service.create_clerking_result(agent, result)
+            service.create_clerking_result(agent, result)  # duplicate upload
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=clerk_worker, args=(a,))
+               for (a, _) in committee]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+
+    status = service.get_aggregation_status(recipient, agg.id)
+    assert status.snapshots[0].number_of_clerking_results == 8
+    assert status.snapshots[0].result_ready
+    for (agent, _) in committee:
+        assert service.get_clerking_job(agent, agent.id) is None  # queue drained
+
+
+def test_concurrent_agent_registration(service):
+    agents = [new_agent() for _ in range(40)]
+    errors = []
+
+    def register(a):
+        try:
+            service.create_agent(a, a)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=register, args=(a,)) for a in agents]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for a in agents:
+        assert service.get_agent(a, a.id) == a
