@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI entry point (reference analog: Jenkinsfile — unit tests, integration
+# tests across service seams, and the shell walkthrough).
+#
+# Usage: bash ci.sh          # full run on the CPU backend
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== pytest (unit + integration + conformance, virtual 8-device mesh)"
+python -m pytest tests/ -q
+
+echo "== CLI walkthrough (real sdad + sda over HTTP)"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu bash docs/walkthrough.sh | tail -1 | {
+  read -r reveal
+  echo "reveal: $reveal"
+  [ "$reveal" = "0 2 2 4 4 6 6 8 8 10" ] || { echo "walkthrough output mismatch"; exit 1; }
+}
+
+echo "CI OK"
