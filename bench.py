@@ -37,7 +37,12 @@ def main() -> None:
     # 28 bits lands on a Solinas prime (2^29 - 679): the uint32 fast path
     t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
     scheme = PackedShamirSharing(3, 8, t, p, w2, w3)
-    fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
+    if os.environ.get("SDA_PALLAS") == "1":
+        from sda_tpu.fields.pallas_round import single_chip_round_pallas
+
+        fn = jax.jit(single_chip_round_pallas(scheme, FullMasking(p)))
+    else:
+        fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
 
     rng = np.random.default_rng(0)
     inputs = jnp.asarray(

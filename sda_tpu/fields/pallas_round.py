@@ -1,0 +1,237 @@
+"""Fused Pallas kernel: mask + share + participant-combine in one HBM pass.
+
+The XLA fast path (fields.fastfield) still materializes the [P, n, B] share
+tensor in HBM between the share matmul and the clerk combine — for the
+flagship config that's ~2GB of write+read traffic. This kernel fuses the
+participant loop: for each dimension tile it draws the masks and share
+randomness on-core (pltpu PRNG), forms each participant's shares in VMEM,
+and folds them straight into [n, TB] accumulators. HBM traffic drops to
+one read of the inputs plus accumulator-sized writes.
+
+Algebra is the uint32 Solinas fast field (see fastfield.py — same bounds,
+same helpers; fastfield's jnp ops compose inside Pallas kernels). The
+share matrix M is host-side, so every multiply in the unrolled row loop is
+a constant mulmod.
+
+Randomness: `internal` mode uses the TPU per-core PRNG
+(pltpu.prng_random_bits) seeded per (seed, tile); masks cancel within the
+round, so the round stays exact. `external` mode takes pre-drawn bits as
+an input — it exists so the arithmetic is bit-checkable under
+``interpret=True`` on CPU (the TPU PRNG primitive is hardware-only) and is
+also what a protocol-grade deployment would use to inject threefry/ChaCha
+streams (reference mask PRGs: client/src/crypto/masking/*.rs).
+
+Opt-in: `single_chip_round_pallas` is selected by bench/driver code when
+SDA_PALLAS=1; the XLA paths remain the default until the kernel wins on
+real hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import fastfield
+from .fastfield import SolinasPrime, canon32, modadd32, modsub32, mulmod32_const
+from . import numtheory
+from .sharing import batch_columns, unbatch_columns
+
+_U32 = jnp.uint32
+
+
+def _uniform_from_bits(hi_bits, lo_bits, sp: SolinasPrime):
+    """Two uint32 draws -> canonical uniform residue (fastfield.uniform32)."""
+    hi = canon32(hi_bits, sp)
+    lo = canon32(lo_bits, sp)
+    r32 = (1 << 32) % sp.p
+    return modadd32(mulmod32_const(hi, r32, sp), lo, sp)
+
+
+def _share_rows_const(values_rows, m_host_row, sp: SolinasPrime):
+    """Sum_j M[i][j]*values[j] for one output row, all constants."""
+    acc = None
+    for coeff, row in zip(m_host_row, values_rows):
+        if coeff % sp.p == 0:
+            continue
+        term = mulmod32_const(row, int(coeff), sp)
+        acc = term if acc is None else modadd32(acc, term, sp)
+    if acc is None:
+        acc = jnp.zeros_like(values_rows[0])
+    return acc
+
+
+def fused_mask_share_combine(
+    x_cols,
+    seed,
+    sp: SolinasPrime,
+    m_host: np.ndarray,
+    privacy_threshold: int,
+    masked: bool,
+    tile: int = 512,
+    external_bits=None,
+    interpret: bool = False,
+):
+    """[P, k, B] canonical uint32 columns -> ([n, B] combined shares,
+    [k, B] mask totals).
+
+    external_bits: optional [P, 2*(k+t) or 2*t, B] uint32 pre-drawn bits
+    (2 words per drawn residue; mask rows first when masked) — used for
+    interpret-mode tests and injectable PRG streams.
+    """
+    P, k, B = x_cols.shape
+    n, m2 = m_host.shape
+    t = privacy_threshold
+    if m2 != 1 + k + t:
+        raise ValueError(f"share matrix width {m2} != 1+k+t={1 + k + t}")
+    if B % tile:
+        raise ValueError(f"B={B} must be divisible by tile={tile}")
+    draws = (k + t) if masked else t
+    internal = external_bits is None
+
+    m_rows = [[int(v) for v in row] for row in np.asarray(m_host)]
+
+    def kernel(*refs):
+        if internal:
+            seed_ref, x_ref, shares_ref, masktot_ref = refs
+        else:
+            seed_ref, x_ref, bits_ref, shares_ref, masktot_ref = refs
+        if internal:
+            pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+
+        def draw(shape, row0, p_ix):
+            if internal:
+                hi = pltpu.bitcast(pltpu.prng_random_bits(shape), _U32)
+                lo = pltpu.bitcast(pltpu.prng_random_bits(shape), _U32)
+            else:
+                hi = bits_ref[p_ix, 2 * row0 : 2 * row0 + shape[0], :]
+                lo = bits_ref[p_ix, 2 * row0 + shape[0] : 2 * (row0 + shape[0]), :]
+            return _uniform_from_bits(hi, lo, sp)
+
+        shares_ref[...] = jnp.zeros_like(shares_ref)
+        masktot_ref[...] = jnp.zeros_like(masktot_ref)
+
+        def body(p_ix, _):
+            x_p = canon32(x_ref[p_ix], sp)                        # [k, TB]
+            if masked:
+                mask = draw((k, tile), 0, p_ix)                   # [k, TB]
+                values_k = modadd32(x_p, mask, sp)
+                masktot_ref[...] = modadd32(masktot_ref[...], mask, sp)
+                rand = draw((t, tile), k, p_ix)
+            else:
+                values_k = x_p
+                rand = draw((t, tile), 0, p_ix)
+            # rows of the values column vector, minus the fixed zero row
+            # (share matrix column 0 multiplies 0); kept 2D [1, TB]
+            rows = [values_k[j : j + 1, :] for j in range(k)] + [
+                rand[j : j + 1, :] for j in range(t)
+            ]
+            for i in range(n):
+                contrib = _share_rows_const(rows, m_rows[i][1:], sp)
+                shares_ref[i : i + 1, :] = modadd32(
+                    shares_ref[i : i + 1, :], contrib, sp
+                )
+            return 0
+
+        jax.lax.fori_loop(0, P, body, 0)
+
+    grid = (B // tile,)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),                     # seed
+        pl.BlockSpec((P, k, tile), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+    ]
+    args = [jnp.asarray([seed], jnp.int32), x_cols]
+    if not internal:
+        in_specs.append(
+            pl.BlockSpec((P, 2 * draws, tile), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM)
+        )
+        args.append(external_bits)
+    out_specs = [
+        pl.BlockSpec((n, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((n, B), _U32),
+        jax.ShapeDtypeStruct((k, B), _U32),
+    ]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+
+
+def single_chip_round_pallas(
+    sharing_scheme,
+    masking_scheme=None,
+    tile: int = 512,
+    interpret: bool = False,
+    external_bits_fn=None,
+):
+    """Drop-in alternative to mesh.single_chip_round on the fused kernel.
+
+    Requires a Solinas prime. external_bits_fn(key, P, draws, B) -> uint32
+    bits array enables deterministic/interpret-mode testing.
+    """
+    from ..protocol import FullMasking, NoMasking
+
+    s = sharing_scheme
+    masking = masking_scheme or NoMasking()
+    if not isinstance(masking, (NoMasking, FullMasking)):
+        raise ValueError("pallas round masking: None or Full")
+    if isinstance(masking, FullMasking) and masking.modulus != s.prime_modulus:
+        raise ValueError("masking modulus must equal the sharing prime")
+    sp = SolinasPrime.try_from(s.prime_modulus)
+    if sp is None:
+        raise ValueError(f"prime {s.prime_modulus} is not Solinas-form")
+    masked = isinstance(masking, FullMasking)
+    m_host = numtheory.packed_share_matrix(
+        s.secret_count, s.share_count, s.privacy_threshold,
+        s.prime_modulus, s.omega_secrets, s.omega_shares,
+    )
+    l_host = numtheory.packed_reconstruct_matrix(
+        s.secret_count, s.share_count, s.privacy_threshold,
+        s.prime_modulus, s.omega_secrets, s.omega_shares,
+        tuple(range(s.share_count)),
+    )
+    k = s.secret_count
+    t = s.privacy_threshold
+    draws = (k + t) if masked else t
+
+    def round_fn(inputs, key):
+        from ..mesh.simpod import _to_residues32
+
+        P, d = inputs.shape
+        x = _to_residues32(inputs, sp)
+        x_cols = batch_columns(x, k)                               # [P, k, B0]
+        B0 = x_cols.shape[-1]
+        pad = (-B0) % tile
+        if pad:
+            x_cols = jnp.pad(x_cols, ((0, 0), (0, 0), (0, pad)))
+        B = B0 + pad
+        seed = jax.random.randint(key, (), 0, np.int32(2**31 - 1), dtype=jnp.int32)
+        ext = None
+        if external_bits_fn is not None:
+            ext = external_bits_fn(key, P, draws, B)
+        shares, mask_tot = fused_mask_share_combine(
+            x_cols, seed, sp, m_host, t, masked,
+            tile=tile, external_bits=ext, interpret=interpret,
+        )
+        from .sharing import packed_reconstruct32
+
+        total = packed_reconstruct32(shares[:, :B0], l_host, sp, dimension=d)
+        if masked:
+            mask_flat = unbatch_columns(mask_tot[:, :B0], d)
+            total = modsub32(total, mask_flat, sp)
+        return total.astype(jnp.int64)
+
+    return round_fn
