@@ -93,9 +93,22 @@ class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
         self.dimension = dimension
         self.seed_bitsize = seed_bitsize
 
+    @staticmethod
+    def _device_backend() -> bool:
+        import jax
+
+        try:
+            return jax.default_backend() != "cpu"
+        except Exception:
+            return False
+
     def _expand(self, seed):
         from .. import native
 
+        if self._device_backend():
+            from ..fields import chacha_jax
+
+            return chacha_jax.expand_mask(seed, self.dimension, self.modulus)
         if native.available():
             return native.chacha_expand_mask(seed, self.dimension, self.modulus)
         return chacha.expand_mask(seed, self.dimension, self.modulus)
@@ -117,6 +130,12 @@ class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
         if len(seeds) == 0:
             return np.zeros(self.dimension, dtype=np.int64)
         stacked = np.stack([np.asarray(s, dtype=np.int64) for s in seeds])
+        if self._device_backend():
+            from ..fields import chacha_jax
+
+            return chacha_jax.combine_masks(
+                [[int(w) for w in s] for s in stacked], self.dimension, self.modulus
+            )
         if native.available():
             return native.chacha_combine_masks(stacked, self.dimension, self.modulus)
         result = np.zeros(self.dimension, dtype=np.int64)

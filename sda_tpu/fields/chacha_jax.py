@@ -1,0 +1,147 @@
+"""Device-side ChaCha20 mask expansion (CHACHA_PRG_V1, bit-exact).
+
+SURVEY.md hard part (e): the ChaCha-seed masking path must stay
+wire-compatible while the recipient's mask re-expansion — the reference's
+recipient hot loop, O(participants x dimension) PRG work
+(client/src/receive.rs:102-118) — moves onto the TPU. ChaCha20 is pure
+uint32 add/xor/rotate, ideal VPU work: all blocks advance through the 20
+rounds in parallel lanes.
+
+Bit-exactness with the host spec (fields.chacha) includes its *rejection
+sampling*: a u64 draw above the acceptance zone shifts every later output.
+Rejection is data-dependent and therefore unjittable — but its probability
+is < modulus/2^64 (< 2^-35 per draw). So the device path expands without
+rejection, simultaneously checks whether any of the first `dimension`
+draws would have been rejected, and in that (practically never hit) case
+the caller replays on the host oracle. Outputs are identical to
+``chacha.expand_mask`` in every case.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .chacha import _CONSTANTS
+
+_U32 = jnp.uint32
+
+
+def _rotl(x, n: int):
+    return (x << _U32(n)) | (x >> _U32(32 - n))
+
+
+def _quarter(s, a, b, c, d):
+    s[a] = s[a] + s[b]
+    s[d] = _rotl(s[d] ^ s[a], 16)
+    s[c] = s[c] + s[d]
+    s[b] = _rotl(s[b] ^ s[c], 12)
+    s[a] = s[a] + s[b]
+    s[d] = _rotl(s[d] ^ s[a], 8)
+    s[c] = s[c] + s[d]
+    s[b] = _rotl(s[b] ^ s[c], 7)
+
+
+@functools.partial(jax.jit, static_argnames=("nblocks",))
+def chacha_block_words(seed_words, counter0, *, nblocks: int):
+    """[nblocks, 16] uint32 keystream; mirrors chacha.chacha_block_words.
+
+    seed_words: [8] uint32 key (zero-padded); counter0: scalar int32/uint32.
+    """
+    counters = jnp.asarray(counter0, _U32) + jnp.arange(nblocks, dtype=_U32)
+    zeros = jnp.zeros((nblocks,), _U32)
+    init = (
+        [jnp.full((nblocks,), _U32(c)) for c in _CONSTANTS]
+        + [jnp.broadcast_to(seed_words[i], (nblocks,)).astype(_U32) for i in range(8)]
+        + [counters, zeros, zeros, zeros]
+    )
+    state = list(init)
+    for _ in range(10):
+        _quarter(state, 0, 4, 8, 12)
+        _quarter(state, 1, 5, 9, 13)
+        _quarter(state, 2, 6, 10, 14)
+        _quarter(state, 3, 7, 11, 15)
+        _quarter(state, 0, 5, 10, 15)
+        _quarter(state, 1, 6, 11, 12)
+        _quarter(state, 2, 7, 8, 13)
+        _quarter(state, 3, 4, 9, 14)
+    words = [s + i for s, i in zip(state, init)]
+    return jnp.stack(words, axis=1)  # [nblocks, 16]
+
+
+@functools.partial(jax.jit, static_argnames=("dimension", "modulus"))
+def _expand_no_reject(seed_words, *, dimension: int, modulus: int):
+    """(mask [dimension] int64, any_rejected bool) — fast path."""
+    # match the host oracle's first-iteration overdraw: ceil(d/8)+1 blocks
+    nblocks = max(1, -(-dimension // 8) + 1)
+    words = chacha_block_words(seed_words, 0, nblocks=nblocks).reshape(-1)
+    lo = words[0::2].astype(jnp.uint64)
+    hi = words[1::2].astype(jnp.uint64)
+    v = (hi << jnp.uint64(32)) | lo
+    zone = jnp.uint64(((1 << 64) // modulus) * modulus - 1)
+    first = v[:dimension]
+    any_rejected = jnp.any(first > zone)
+    mask = jnp.mod(first, jnp.uint64(modulus)).astype(jnp.int64)
+    return mask, any_rejected
+
+
+@functools.partial(jax.jit, static_argnames=("dimension", "modulus"))
+def _combine_no_reject(seed_matrix, *, dimension: int, modulus: int):
+    """[S, 8] seeds -> (sum of masks mod m [dimension] int64, [S] rejected)."""
+    masks, rejected = jax.vmap(
+        lambda sw: _expand_no_reject(sw, dimension=dimension, modulus=modulus)
+    )(seed_matrix)
+    total = jnp.mod(jnp.sum(masks, axis=0, dtype=jnp.int64), modulus)
+    return total, rejected
+
+
+def combine_masks(seeds, dimension: int, modulus: int) -> np.ndarray:
+    """Sum of all seeds' expanded masks mod m — the recipient hot loop
+    (receive.rs:102-118), every seed's 20-round expansion in parallel lanes.
+    Bit-identical to summing chacha.expand_mask per seed."""
+    seed_matrix = np.zeros((len(seeds), 8), dtype=np.uint32)
+    for i, seed in enumerate(seeds):
+        if len(seed) > 8:
+            raise ValueError("seed longer than 256 bits")
+        for j, w in enumerate(seed):
+            seed_matrix[i, j] = np.uint32(int(w) & 0xFFFFFFFF)
+    total, rejected = _combine_no_reject(
+        jnp.asarray(seed_matrix), dimension=dimension, modulus=modulus
+    )
+    rejected = np.asarray(rejected)
+    if rejected.any():  # replay the affected seeds exactly on the host
+        from . import chacha
+
+        total = np.asarray(total, dtype=np.int64)
+        for i in np.nonzero(rejected)[0]:
+            seed = [int(w) for w in seeds[i]]
+            wrong, _ = _expand_no_reject(
+                jnp.asarray(seed_matrix[i]), dimension=dimension, modulus=modulus
+            )
+            right = chacha.expand_mask(seed, dimension, modulus)
+            total = (total - np.asarray(wrong) + right) % modulus
+        return total
+    return np.asarray(total)
+
+
+def expand_mask(seed: Sequence[int], dimension: int, modulus: int) -> np.ndarray:
+    """Drop-in device-accelerated chacha.expand_mask (bit-identical)."""
+    if modulus <= 0 or modulus >= (1 << 62):
+        raise ValueError("modulus out of range")
+    if len(seed) > 8:
+        raise ValueError("seed longer than 256 bits")
+    seed_words = np.zeros(8, dtype=np.uint32)
+    for i, w in enumerate(seed):
+        seed_words[i] = np.uint32(w & 0xFFFFFFFF)
+    mask, any_rejected = _expand_no_reject(
+        jnp.asarray(seed_words), dimension=dimension, modulus=modulus
+    )
+    if bool(any_rejected):  # p < dimension * modulus / 2^64 — practically never
+        from . import chacha
+
+        return chacha.expand_mask(seed, dimension, modulus)
+    return np.asarray(mask)
