@@ -250,9 +250,21 @@ class SdaClient:
             self.agent, Snapshot(id=SnapshotId.random(), aggregation=aggregation_id)
         )
 
-    def reveal_aggregation(self, aggregation_id: AggregationId) -> RecipientOutput:
+    def snapshot_aggregation(self, aggregation_id: AggregationId) -> SnapshotId:
+        """Freeze the current participation set as a NEW snapshot even if
+        earlier ones exist — round pipelining: several snapshots of one
+        aggregation proceed through clerking independently (SURVEY §2.4;
+        the reference server supports this, its client never drives it)."""
+        snapshot = Snapshot(id=SnapshotId.random(), aggregation=aggregation_id)
+        self.service.create_snapshot(self.agent, snapshot)
+        return snapshot.id
+
+    def reveal_aggregation(
+        self, aggregation_id: AggregationId, snapshot_id: Optional[SnapshotId] = None
+    ) -> RecipientOutput:
         """Decrypt clerk results, reconstruct, combine+subtract masks
-        (receive.rs:80-157)."""
+        (receive.rs:80-157). ``snapshot_id`` selects a specific pipelined
+        round; default is the first result-ready snapshot (receive.rs:91-94)."""
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
             raise NotFound(f"unknown aggregation {aggregation_id}")
@@ -263,7 +275,13 @@ class SdaClient:
         status = self.service.get_aggregation_status(self.agent, aggregation_id)
         if status is None:
             raise NotFound("unknown aggregation")
-        snapshot = next((s for s in status.snapshots if s.result_ready), None)
+        if snapshot_id is not None:
+            snapshot = next(
+                (s for s in status.snapshots
+                 if s.id == snapshot_id and s.result_ready), None
+            )
+        else:
+            snapshot = next((s for s in status.snapshots if s.result_ready), None)
         if snapshot is None:
             raise NotFound("aggregation not ready")
         result = self.service.get_snapshot_result(self.agent, aggregation_id, snapshot.id)
