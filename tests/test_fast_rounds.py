@@ -72,3 +72,17 @@ def test_pod_golden_prime_uses_generic_path():
     inputs = rng.integers(0, 50, size=(16, 12))
     out = np.asarray(pod.aggregate(inputs, key=jax.random.PRNGKey(1)))
     np.testing.assert_array_equal(out, inputs.sum(axis=0) % 433)
+
+
+def test_large_committee_scheme_round():
+    """n=26 committee (m3=27, m2=16): generator finds a Solinas prime with
+    432 | p-1 and the fast round stays exact at radix-3 scale."""
+    t, p, w2, w3 = numtheory.generate_packed_params(11, 26, 26)
+    s = PackedShamirSharing(11, 26, t, p, w2, w3)
+    assert s.reconstruction_threshold == t + 11 <= 26
+    fn = jax.jit(single_chip_round(s, FullMasking(p) if fastfield.supported(p)
+                                   else NoMasking()))
+    rng = np.random.default_rng(31)
+    inputs = rng.integers(0, 1 << 16, size=(4, 11 * 7))
+    out = np.asarray(fn(jax.numpy.asarray(inputs), jax.random.PRNGKey(6)))
+    np.testing.assert_array_equal(out, inputs.sum(axis=0) % p)
