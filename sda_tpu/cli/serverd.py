@@ -2,8 +2,8 @@
 
 Reference: server-cli (sdad --jfs|--mongo httpd, bind 127.0.0.1:8888).
 Backends here: durable JSON files (--jfs DIR), single-file SQLite database
-(--sqlite PATH — the production tier, reference analog --mongo), or
-in-memory (--memory).
+(--sqlite PATH), MongoDB (--mongo URI, reference parity, needs pymongo),
+or in-memory (--memory).
 """
 
 from __future__ import annotations
@@ -17,6 +17,8 @@ def build_parser() -> argparse.ArgumentParser:
     backend = parser.add_mutually_exclusive_group()
     backend.add_argument("--jfs", metavar="DIR", help="JSON-file store root")
     backend.add_argument("--sqlite", metavar="PATH", help="SQLite database file")
+    backend.add_argument("--mongo", metavar="URI", help="MongoDB URI (needs pymongo)")
+    parser.add_argument("--mongo-dbname", default="sda")
     backend.add_argument("--memory", action="store_true", help="in-memory store")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -31,12 +33,19 @@ def main(argv=None) -> int:
 
     configure_logging(args.verbose)
     from ..http import SdaHttpServer
-    from ..server import new_jsonfs_server, new_memory_server, new_sqlite_server
+    from ..server import (
+        new_jsonfs_server,
+        new_memory_server,
+        new_mongo_server,
+        new_sqlite_server,
+    )
 
     if args.memory:
         service = new_memory_server()
     elif args.sqlite:
         service = new_sqlite_server(args.sqlite)
+    elif args.mongo:
+        service = new_mongo_server(args.mongo, args.mongo_dbname)
     else:
         service = new_jsonfs_server(args.jfs or "./sdad-store")
 

@@ -59,6 +59,32 @@ def new_sqlite_server(path) -> SdaServerService:
     )
 
 
+def new_mongo_server(uri_or_db, dbname: str = "sda") -> SdaServerService:
+    """MongoDB-backed server (reference: server-store-mongodb/). Accepts a
+    connection URI (needs pymongo) or a pymongo-compatible Database object."""
+    from . import mongo
+
+    if isinstance(uri_or_db, str):
+        if not mongo.available():
+            raise RuntimeError(
+                "pymongo is not installed; pass a pymongo-compatible Database "
+                "or use new_sqlite_server for the in-image production tier"
+            )
+        import pymongo
+
+        db = pymongo.MongoClient(uri_or_db)[dbname]
+    else:
+        db = uri_or_db
+    return SdaServerService(
+        SdaServer(
+            agents_store=mongo.MongoAgentsStore(db),
+            auth_tokens_store=mongo.MongoAuthTokensStore(db),
+            aggregation_store=mongo.MongoAggregationsStore(db),
+            clerking_job_store=mongo.MongoClerkingJobsStore(db),
+        )
+    )
+
+
 def new_jsonfs_server(directory) -> SdaServerService:
     """Durable JSON-file-backed server (reference: new_jfs_server,
     server/src/lib.rs:34-45)."""

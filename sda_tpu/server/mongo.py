@@ -1,0 +1,318 @@
+"""MongoDB store backend — document-database tier (reference parity).
+
+Direct analog of the reference's production storage
+(server-store-mongodb/src/lib.rs): one collection per resource holding the
+JSON document keyed by ``_id``, upserts via ``replace_one(upsert=True)``
+(the Mongo store's ``modisert``, lib.rs:118-151), snapshot freezing as an
+``$addToSet`` of the snapshot id onto participation documents
+(aggregations.rs:132-142), and a done-flag clerk-job queue with an atomic
+``find_one_and_update`` flip (clerking_jobs.rs:32-75).
+
+``pymongo`` is not part of this image, so the module is import-gated:
+``available()`` is False without the driver and ``new_mongo_server``
+raises a clear error. The semantics mirror the SQLite backend
+(sqlite.py), which runs the same store test suites in-image; when a Mongo
+deployment is present, point ``sdad --mongo URI`` at it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+try:  # driver not baked into this image; gate, don't fail at import
+    import pymongo
+
+    _PYMONGO = True
+except ImportError:  # pragma: no cover - exercised only without the driver
+    pymongo = None
+    _PYMONGO = False
+
+from ..protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    ClerkCandidate,
+    ClerkingJob,
+    ClerkingJobId,
+    ClerkingResult,
+    Committee,
+    Encryption,
+    EncryptionKeyId,
+    NotFound,
+    Participation,
+    Profile,
+    Snapshot,
+    SnapshotId,
+    signed_encryption_key_from_obj,
+)
+from .stores import (
+    AgentsStore,
+    AggregationsStore,
+    AuthTokensStore,
+    BaseStore,
+    ClerkingJobsStore,
+    auth_token,
+)
+
+
+def available() -> bool:
+    return _PYMONGO
+
+
+class _MongoStore(BaseStore):
+    def __init__(self, db):
+        self.db = db
+
+    def ping(self) -> None:
+        self.db.command("ping")
+
+
+class MongoAuthTokensStore(_MongoStore, AuthTokensStore):
+    def upsert_auth_token(self, token):
+        self.db.auth_tokens.replace_one(
+            {"_id": str(token.id)}, {"_id": str(token.id), "body": token.body},
+            upsert=True,
+        )
+
+    def get_auth_token(self, id):
+        doc = self.db.auth_tokens.find_one({"_id": str(id)})
+        return None if doc is None else auth_token(id, doc["body"])
+
+    def delete_auth_token(self, id):
+        self.db.auth_tokens.delete_one({"_id": str(id)})
+
+
+class MongoAgentsStore(_MongoStore, AgentsStore):
+    def create_agent(self, agent):
+        self.db.agents.replace_one(
+            {"_id": str(agent.id)}, {"_id": str(agent.id), "doc": agent.to_obj()},
+            upsert=True,
+        )
+
+    def get_agent(self, id):
+        doc = self.db.agents.find_one({"_id": str(id)})
+        return None if doc is None else Agent.from_obj(doc["doc"])
+
+    def upsert_profile(self, profile):
+        self.db.profiles.replace_one(
+            {"_id": str(profile.owner)},
+            {"_id": str(profile.owner), "doc": profile.to_obj()},
+            upsert=True,
+        )
+
+    def get_profile(self, owner):
+        doc = self.db.profiles.find_one({"_id": str(owner)})
+        return None if doc is None else Profile.from_obj(doc["doc"])
+
+    def create_encryption_key(self, key):
+        self.db.enc_keys.replace_one(
+            {"_id": str(key.body.id)},
+            {"_id": str(key.body.id), "signer": str(key.signer), "doc": key.to_obj()},
+            upsert=True,
+        )
+
+    def get_encryption_key(self, key):
+        doc = self.db.enc_keys.find_one({"_id": str(key)})
+        return None if doc is None else signed_encryption_key_from_obj(doc["doc"])
+
+    def suggest_committee(self):
+        # group keys by signer, sorted — the reference does this with a
+        # client-side itertools group (jfs_stores/agents.rs:66-83)
+        candidates: List[ClerkCandidate] = []
+        for doc in self.db.enc_keys.find().sort([("signer", 1), ("_id", 1)]):
+            signer, key_id = doc["signer"], doc["_id"]
+            if candidates and str(candidates[-1].id) == signer:
+                candidates[-1].keys.append(EncryptionKeyId(key_id))
+            else:
+                candidates.append(
+                    ClerkCandidate(id=AgentId(signer), keys=[EncryptionKeyId(key_id)])
+                )
+        return candidates
+
+
+class MongoAggregationsStore(_MongoStore, AggregationsStore):
+    def list_aggregations(self, filter=None, recipient=None):
+        query = {}
+        if filter is not None:
+            query["title"] = {"$regex": filter}
+        if recipient is not None:
+            query["recipient"] = str(recipient)
+        return [
+            AggregationId(d["_id"])
+            for d in self.db.aggregations.find(query).sort("_id", 1)
+        ]
+
+    def create_aggregation(self, aggregation):
+        self.db.aggregations.replace_one(
+            {"_id": str(aggregation.id)},
+            {
+                "_id": str(aggregation.id),
+                "title": aggregation.title,
+                "recipient": str(aggregation.recipient),
+                "doc": aggregation.to_obj(),
+            },
+            upsert=True,
+        )
+
+    def get_aggregation(self, aggregation):
+        doc = self.db.aggregations.find_one({"_id": str(aggregation)})
+        return None if doc is None else Aggregation.from_obj(doc["doc"])
+
+    def delete_aggregation(self, aggregation):
+        agg = str(aggregation)
+        snap_ids = [d["_id"] for d in self.db.snapshots.find({"aggregation": agg})]
+        if snap_ids:
+            self.db.snapshot_masks.delete_many({"_id": {"$in": snap_ids}})
+        self.db.participations.delete_many({"aggregation": agg})
+        self.db.snapshots.delete_many({"aggregation": agg})
+        self.db.committees.delete_one({"_id": agg})
+        self.db.aggregations.delete_one({"_id": agg})
+
+    def get_committee(self, aggregation):
+        doc = self.db.committees.find_one({"_id": str(aggregation)})
+        return None if doc is None else Committee.from_obj(doc["doc"])
+
+    def create_committee(self, committee):
+        self.db.committees.replace_one(
+            {"_id": str(committee.aggregation)},
+            {"_id": str(committee.aggregation), "doc": committee.to_obj()},
+            upsert=True,
+        )
+
+    def create_participation(self, participation):
+        if self.get_aggregation(participation.aggregation) is None:
+            raise NotFound("aggregation not found")
+        self.db.participations.replace_one(
+            {"_id": str(participation.id)},
+            {
+                "_id": str(participation.id),
+                "aggregation": str(participation.aggregation),
+                "snapshots": [],
+                "doc": participation.to_obj(),
+            },
+            upsert=True,
+        )
+
+    def create_snapshot(self, snapshot):
+        self.db.snapshots.replace_one(
+            {"_id": str(snapshot.id)},
+            {
+                "_id": str(snapshot.id),
+                "aggregation": str(snapshot.aggregation),
+                "doc": snapshot.to_obj(),
+            },
+            upsert=True,
+        )
+
+    def list_snapshots(self, aggregation):
+        return [
+            SnapshotId(d["_id"])
+            for d in self.db.snapshots.find(
+                {"aggregation": str(aggregation)}).sort("_id", 1)
+        ]
+
+    def get_snapshot(self, aggregation, snapshot):
+        doc = self.db.snapshots.find_one(
+            {"_id": str(snapshot), "aggregation": str(aggregation)}
+        )
+        return None if doc is None else Snapshot.from_obj(doc["doc"])
+
+    def count_participations(self, aggregation):
+        return self.db.participations.count_documents(
+            {"aggregation": str(aggregation)}
+        )
+
+    def snapshot_participations(self, aggregation, snapshot):
+        # the reference's $addToSet freeze (aggregations.rs:132-142)
+        self.db.participations.update_many(
+            {"aggregation": str(aggregation)},
+            {"$addToSet": {"snapshots": str(snapshot)}},
+        )
+
+    def count_participations_snapshot(self, aggregation, snapshot):
+        return self.db.participations.count_documents(
+            {"aggregation": str(aggregation), "snapshots": str(snapshot)}
+        )
+
+    def iter_snapped_participations(self, aggregation, snapshot):
+        return [
+            Participation.from_obj(d["doc"])
+            for d in self.db.participations.find(
+                {"aggregation": str(aggregation), "snapshots": str(snapshot)}
+            ).sort("_id", 1)
+        ]
+
+    def create_snapshot_mask(self, snapshot, mask):
+        self.db.snapshot_masks.replace_one(
+            {"_id": str(snapshot)},
+            {"_id": str(snapshot), "doc": [e.to_obj() for e in mask]},
+            upsert=True,
+        )
+
+    def get_snapshot_mask(self, snapshot):
+        doc = self.db.snapshot_masks.find_one({"_id": str(snapshot)})
+        if doc is None:
+            return None
+        return [Encryption.from_obj(e) for e in doc["doc"]]
+
+
+class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
+    def enqueue_clerking_job(self, job):
+        self.db.clerking_jobs.replace_one(
+            {"_id": str(job.id)},
+            {
+                "_id": str(job.id),
+                "clerk": str(job.clerk),
+                "snapshot": str(job.snapshot),
+                "done": False,
+                "doc": job.to_obj(),
+            },
+            upsert=True,
+        )
+
+    def poll_clerking_job(self, clerk):
+        doc = self.db.clerking_jobs.find_one(
+            {"clerk": str(clerk), "done": False}, sort=[("_id", 1)]
+        )
+        return None if doc is None else ClerkingJob.from_obj(doc["doc"])
+
+    def get_clerking_job(self, clerk, job):
+        doc = self.db.clerking_jobs.find_one({"_id": str(job), "clerk": str(clerk)})
+        return None if doc is None else ClerkingJob.from_obj(doc["doc"])
+
+    def create_clerking_result(self, result):
+        # atomic done-flag flip: only the first upload stores a result
+        doc = self.db.clerking_jobs.find_one_and_update(
+            {"_id": str(result.job), "clerk": str(result.clerk), "done": False},
+            {"$set": {"done": True}},
+        )
+        if doc is None:
+            already = self.db.clerking_jobs.find_one(
+                {"_id": str(result.job), "clerk": str(result.clerk)}
+            )
+            if already is not None and already.get("done"):
+                return  # duplicate result upload: idempotent
+            raise NotFound("job not found for clerk")
+        self.db.clerking_results.replace_one(
+            {"_id": str(result.job)},
+            {
+                "_id": str(result.job),
+                "snapshot": doc["snapshot"],
+                "doc": result.to_obj(),
+            },
+            upsert=True,
+        )
+
+    def list_results(self, snapshot):
+        return [
+            ClerkingJobId(d["_id"])
+            for d in self.db.clerking_results.find(
+                {"snapshot": str(snapshot)}).sort("_id", 1)
+        ]
+
+    def get_result(self, snapshot, job):
+        doc = self.db.clerking_results.find_one(
+            {"_id": str(job), "snapshot": str(snapshot)}
+        )
+        return None if doc is None else ClerkingResult.from_obj(doc["doc"])

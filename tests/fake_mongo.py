@@ -1,0 +1,145 @@
+"""Minimal in-memory pymongo-compatible fake for exercising the Mongo store.
+
+Implements exactly the surface sda_tpu.server.mongo uses — replace_one
+(upsert), find/find_one with sorts, delete_one/many, update_many with
+$addToSet, count_documents, find_one_and_update with $set — including
+Mongo's array-field equality semantics ({"snapshots": "x"} matches
+documents whose ``snapshots`` list contains "x"). Lets the whole store
+test suite run without a mongod; a real deployment uses pymongo.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Dict, List, Optional
+
+
+def _matches(doc: Dict[str, Any], query: Dict[str, Any]) -> bool:
+    for field, cond in query.items():
+        value = doc.get(field)
+        if isinstance(cond, dict):
+            for op, arg in cond.items():
+                if op == "$regex":
+                    import re
+
+                    if not isinstance(value, str) or re.search(arg, value) is None:
+                        return False
+                elif op == "$in":
+                    if value not in arg:
+                        return False
+                else:
+                    raise NotImplementedError(f"fake_mongo: operator {op}")
+        elif isinstance(value, list) and not isinstance(cond, list):
+            if cond not in value:  # Mongo array-contains equality
+                return False
+        elif value != cond:
+            return False
+    return True
+
+
+def _apply_update(doc: Dict[str, Any], update: Dict[str, Any]) -> None:
+    for op, fields in update.items():
+        if op == "$set":
+            doc.update(fields)
+        elif op == "$addToSet":
+            for field, item in fields.items():
+                arr = doc.setdefault(field, [])
+                if item not in arr:
+                    arr.append(item)
+        else:
+            raise NotImplementedError(f"fake_mongo: update op {op}")
+
+
+class _Cursor:
+    def __init__(self, docs: List[Dict[str, Any]]):
+        self._docs = docs
+
+    def sort(self, key_or_list, direction: int = 1) -> "_Cursor":
+        keys = (
+            key_or_list if isinstance(key_or_list, list)
+            else [(key_or_list, direction)]
+        )
+        docs = self._docs
+        for field, d in reversed(keys):
+            docs = sorted(docs, key=lambda doc: doc.get(field), reverse=d < 0)
+        return _Cursor(docs)
+
+    def __iter__(self):
+        return iter(copy.deepcopy(self._docs))
+
+
+class FakeCollection:
+    def __init__(self):
+        self._docs: Dict[Any, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+
+    def _find(self, query: Dict[str, Any]) -> List[Dict[str, Any]]:
+        return [d for d in self._docs.values() if _matches(d, query)]
+
+    def replace_one(self, filter: Dict[str, Any], doc: Dict[str, Any],
+                    upsert: bool = False):
+        with self._lock:
+            found = self._find(filter)
+            if found:
+                self._docs[found[0]["_id"]] = copy.deepcopy(doc)
+            elif upsert:
+                self._docs[doc["_id"]] = copy.deepcopy(doc)
+
+    def find_one(self, query: Dict[str, Any], sort=None) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            docs = self._find(query)
+            if sort:
+                docs = list(_Cursor(docs).sort(sort)._docs)
+            return copy.deepcopy(docs[0]) if docs else None
+
+    def find(self, query: Optional[Dict[str, Any]] = None) -> _Cursor:
+        with self._lock:
+            return _Cursor(self._find(query or {}))
+
+    def delete_one(self, query: Dict[str, Any]):
+        with self._lock:
+            for doc in self._find(query)[:1]:
+                del self._docs[doc["_id"]]
+
+    def delete_many(self, query: Dict[str, Any]):
+        with self._lock:
+            for doc in self._find(query):
+                del self._docs[doc["_id"]]
+
+    def update_many(self, query: Dict[str, Any], update: Dict[str, Any]):
+        with self._lock:
+            for doc in self._find(query):
+                _apply_update(self._docs[doc["_id"]], update)
+
+    def count_documents(self, query: Dict[str, Any]) -> int:
+        with self._lock:
+            return len(self._find(query))
+
+    def find_one_and_update(self, query: Dict[str, Any], update: Dict[str, Any]):
+        """Returns the PRE-update document (pymongo default), atomically."""
+        with self._lock:
+            found = self._find(query)
+            if not found:
+                return None
+            doc = found[0]
+            before = copy.deepcopy(doc)
+            _apply_update(self._docs[doc["_id"]], update)
+            return before
+
+
+class FakeDatabase:
+    def __init__(self):
+        self._collections: Dict[str, FakeCollection] = {}
+        self._lock = threading.RLock()
+
+    def command(self, name: str):
+        if name != "ping":
+            raise NotImplementedError(name)
+        return {"ok": 1}
+
+    def __getattr__(self, name: str) -> FakeCollection:
+        with self._lock:
+            if name not in self._collections:
+                self._collections[name] = FakeCollection()
+            return self._collections[name]

@@ -28,12 +28,17 @@ from sda_tpu.server import (
 from util import new_agent, new_full_agent, new_key_for_agent
 
 
-@pytest.fixture(params=["memory", "jsonfs", "sqlite"])
+@pytest.fixture(params=["memory", "jsonfs", "sqlite", "mongo"])
 def service(request, tmp_path):
     if request.param == "memory":
         return new_memory_server()
     if request.param == "sqlite":
         return new_sqlite_server(tmp_path / "sda.db")
+    if request.param == "mongo":
+        from fake_mongo import FakeDatabase
+        from sda_tpu.server import new_mongo_server
+
+        return new_mongo_server(FakeDatabase())
     return new_jsonfs_server(tmp_path)
 
 

@@ -106,10 +106,15 @@ def check_full_aggregation(aggregation: Aggregation, service):
     np.testing.assert_array_equal(output.positive().values, [2, 4, 6, 8])
 
 
-@pytest.fixture(params=["memory", "jsonfs", "sqlite", "http"])
+@pytest.fixture(params=["memory", "jsonfs", "sqlite", "mongo", "http"])
 def service(request, tmp_path):
     if request.param == "memory":
         yield new_memory_server()
+    elif request.param == "mongo":
+        from fake_mongo import FakeDatabase
+        from sda_tpu.server import new_mongo_server
+
+        yield new_mongo_server(FakeDatabase())
     elif request.param == "sqlite":
         yield new_sqlite_server(tmp_path / "sda.db")
     elif request.param == "jsonfs":

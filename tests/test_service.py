@@ -32,12 +32,17 @@ N_PARTICIPANTS = 100
 N_CLERKS = 3
 
 
-@pytest.fixture(params=["memory", "jsonfs", "sqlite"])
+@pytest.fixture(params=["memory", "jsonfs", "sqlite", "mongo"])
 def service(request, tmp_path):
     if request.param == "memory":
         return new_memory_server()
     if request.param == "sqlite":
         return new_sqlite_server(tmp_path / "sda.db")
+    if request.param == "mongo":
+        from fake_mongo import FakeDatabase
+        from sda_tpu.server import new_mongo_server
+
+        return new_mongo_server(FakeDatabase())
     return new_jsonfs_server(tmp_path)
 
 
