@@ -12,18 +12,77 @@ elements pushed through the full pipeline). vs_baseline compares against
 the 1e9 north-star target (BASELINE.json; the reference publishes no
 numbers, BASELINE.md).
 
-Prints ONE JSON line.
+Robustness contract (VERDICT round 1): the TPU backend on this image can
+crash (`UNAVAILABLE: TPU backend setup/compile error`) or hang at init, and
+the sitecustomize's axon plugin overrides env-var platform selection. So:
+the TPU is probed in a KILLABLE subprocess with a bounded timeout, retried
+once, and on failure the bench falls back to CPU with the platform recorded
+honestly in the output. Exactly ONE JSON line is printed to stdout in every
+exit path that has a measurement; diagnostics go to stderr.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
+_PROBE_CODE = """
+import jax
+jax.config.update("jax_platforms", "axon")
+ds = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.float32)
+(x @ x).block_until_ready()
+print("PROBE_OK", ds[0].platform, getattr(ds[0], "device_kind", "?"), flush=True)
+"""
 
-def main() -> None:
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _probe_tpu(timeout_s: float) -> bool:
+    """Bounded-time TPU liveness check in a subprocess (init can hang)."""
+    for attempt in (1, 2):
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            _log(f"TPU probe attempt {attempt}: timed out after {timeout_s:.0f}s")
+            continue
+        dt = time.perf_counter() - t0
+        if r.returncode == 0 and "PROBE_OK" in r.stdout:
+            _log(f"TPU probe attempt {attempt}: OK in {dt:.1f}s ({r.stdout.strip()})")
+            return True
+        tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
+        _log(
+            f"TPU probe attempt {attempt}: rc={r.returncode} in {dt:.1f}s; "
+            + " | ".join(tail)
+        )
+    return False
+
+
+def _select_platform() -> str:
+    want = os.environ.get("SDA_BENCH_PLATFORM", "auto")
+    if want in ("tpu", "axon"):
+        return "axon"
+    if want == "cpu":
+        return "cpu"
+    timeout_s = float(os.environ.get("SDA_BENCH_TPU_PROBE_TIMEOUT", 300))
+    return "axon" if _probe_tpu(timeout_s) else "cpu"
+
+
+def _run(platform: str, use_pallas: bool) -> dict:
     import jax
+
+    jax.config.update("jax_platforms", platform)
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -31,13 +90,20 @@ def main() -> None:
     from sda_tpu.mesh import single_chip_round
     from sda_tpu.protocol import FullMasking, PackedShamirSharing
 
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    _log(f"running on {dev.platform} ({getattr(dev, 'device_kind', '?')})")
+
     participants = int(os.environ.get("SDA_BENCH_PARTICIPANTS", 100))
-    dim = int(os.environ.get("SDA_BENCH_DIM", 999_999))  # ~1M, divisible by 3
+    # ~1M on TPU; CPU fallback defaults 10x smaller so the bench still lands
+    default_dim = 999_999 if on_tpu else 99_999
+    dim = int(os.environ.get("SDA_BENCH_DIM", default_dim))
 
     # 28 bits lands on a Solinas prime (2^29 - 679): the uint32 fast path
     t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
     scheme = PackedShamirSharing(3, 8, t, p, w2, w3)
-    if os.environ.get("SDA_PALLAS") == "1":
+    use_pallas = use_pallas and on_tpu
+    if use_pallas:
         from sda_tpu.fields.pallas_round import single_chip_round_pallas
 
         fn = jax.jit(single_chip_round_pallas(scheme, FullMasking(p)))
@@ -50,11 +116,13 @@ def main() -> None:
     )
     key = jax.random.PRNGKey(0)
 
-    # warmup / compile
-    out = fn(inputs, key)
+    t0 = time.perf_counter()
+    out = fn(inputs, key)  # warmup / compile
     out.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    _log(f"warmup+compile: {compile_s:.1f}s (pallas={use_pallas})")
 
-    reps = int(os.environ.get("SDA_BENCH_REPS", 3))
+    reps = int(os.environ.get("SDA_BENCH_REPS", 5))
     times = []
     for i in range(reps):
         k = jax.random.fold_in(key, i)
@@ -69,18 +137,54 @@ def main() -> None:
     assert np.array_equal(check, expected), "benchmark round produced wrong aggregate"
 
     value = participants * dim / best
-    print(
-        json.dumps(
-            {
-                "metric": "secure-aggregated shared-elements/sec/chip "
-                "(Packed-Shamir n=8 t=%d p=%d, full mask, %d x %d)"
-                % (t, p, participants, dim),
-                "value": round(value),
-                "unit": "elements/sec",
-                "vs_baseline": round(value / 1e9, 4),
-            }
-        )
+    return {
+        "metric": "secure-aggregated shared-elements/sec/chip "
+        "(Packed-Shamir n=8 t=%d p=%d, full mask, %d x %d)"
+        % (t, p, participants, dim),
+        "value": round(value),
+        "unit": "elements/sec",
+        "vs_baseline": round(value / 1e9, 4),
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "pallas": use_pallas,
+        "round_seconds_best": round(best, 4),
+        "round_seconds_all": [round(x, 4) for x in times],
+        "compile_seconds": round(compile_s, 1),
+    }
+
+
+def main() -> None:
+    platform = _select_platform()
+    # pallas is a no-op off-TPU: normalize so the ladder dedup can see
+    # identical rungs and not repeat a failed CPU run
+    pallas_default = (
+        platform != "cpu" and os.environ.get("SDA_PALLAS", "1") == "1"
     )
+    # fallback ladder: pallas-TPU -> plain-TPU -> CPU; the last rung that
+    # produces a measurement wins, and every exit path prints ONE JSON line
+    ladder = [(platform, pallas_default), (platform, False), ("cpu", False)]
+    attempts = []
+    for rung, (plat, pallas) in enumerate(ladder):
+        if attempts and attempts[-1] == (plat, pallas):
+            continue
+        attempts.append((plat, pallas))
+        try:
+            if rung > 0:
+                from jax.extend.backend import clear_backends
+
+                clear_backends()
+            print(json.dumps(_run(plat, pallas)))
+            return
+        except Exception as e:
+            _log(f"run on {plat!r} (pallas={pallas}) failed: "
+                 f"{type(e).__name__}: {e}")
+            last_error = e
+    print(json.dumps({
+        "metric": "secure-aggregation bench failed on every rung",
+        "value": 0, "unit": "elements/sec", "vs_baseline": 0.0,
+        "error": f"{type(last_error).__name__}: {last_error}",
+    }))
+    raise SystemExit(1)
 
 
 if __name__ == "__main__":

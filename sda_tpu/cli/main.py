@@ -153,17 +153,33 @@ def main(argv=None) -> int:
 
                 k = args.secrets_per_batch
                 # Unless the NTT prime equals the aggregation modulus, sums of
-                # masked values must never wrap mod p — pick p with ~21 bits
-                # of headroom over the modulus (≈2M participants), capped at
-                # 28 so the generator can land on a Solinas prime (uint32
-                # fast path; hard kernel limit is 31 bits).
+                # values mod `modulus` must never wrap mod p: correctness
+                # needs participants * (modulus-1) < p. Request 21 bits of
+                # headroom over the modulus, but cap the request at 28 bits
+                # so the generator lands on a Solinas prime (uint32 fast
+                # path) — for moduli above ~7 bits the cap wins and the REAL
+                # headroom is only (p.bit_length() - modulus bits), so we
+                # report the actual participant capacity below.
                 min_bits = min(args.modulus.bit_length() + 21, 28)
                 t, p, w2, w3 = numtheory.generate_packed_params(
                     k, args.shares, min_modulus_bits=min_bits
                 )
                 if args.modulus != p:
-                    print(f"note: sharing over NTT prime {p} (headroom over "
-                          f"modulus {args.modulus})", file=sys.stderr)
+                    capacity = (p - 1) // max(1, args.modulus - 1)
+                    if capacity < 2:
+                        print(f"error: modulus {args.modulus} does not fit the "
+                              f"NTT prime {p} (even a 2-participant sum can "
+                              f"wrap mod p and reveal a wrong aggregate); use "
+                              f"a smaller modulus", file=sys.stderr)
+                        return 1
+                    print(f"note: sharing over NTT prime {p}; sums stay exact "
+                          f"for up to {capacity} participants at modulus "
+                          f"{args.modulus}", file=sys.stderr)
+                    if capacity < 1000:
+                        print("warning: <1000-participant headroom — use a "
+                              "smaller modulus or a larger prime "
+                              "(--secrets-per-batch/--shares affect the "
+                              "generator)", file=sys.stderr)
                 sharing = PackedShamirSharing(k, args.shares, t, p, w2, w3)
             aggregation = Aggregation(
                 id=AggregationId.random(),

@@ -89,13 +89,38 @@ def _expand_no_reject(seed_words, *, dimension: int, modulus: int):
     return mask, any_rejected
 
 
+def _modsum_i64(x, modulus: int, axis: int = 0):
+    """Overflow-safe modular sum of int64 residues in [0, modulus).
+
+    A flat ``sum() % m`` wraps int64 once n*(m-1) >= 2^63 (e.g. ~16k seeds
+    at a 2^49 modulus); fold in chunks small enough that every partial sum
+    provably fits, canonicalizing between levels — same shape of fix as
+    fastfield.modsum32.
+    """
+    fan = max(2, ((1 << 63) - 1) // max(1, modulus - 1))
+    x = jnp.moveaxis(jnp.asarray(x, jnp.int64), axis, 0)
+    if x.shape[0] == 0:  # empty sum is the zero mask, like jnp.sum(axis=0)
+        return jnp.zeros(x.shape[1:], jnp.int64)
+    while x.shape[0] > 1:
+        n = x.shape[0]
+        chunk = min(fan, n)
+        pad = (-n) % chunk
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + x.shape[1:], jnp.int64)], axis=0
+            )
+        x = x.reshape((x.shape[0] // chunk, chunk) + x.shape[1:])
+        x = jnp.mod(jnp.sum(x, axis=1, dtype=jnp.int64), modulus)
+    return x[0]
+
+
 @functools.partial(jax.jit, static_argnames=("dimension", "modulus"))
 def _combine_no_reject(seed_matrix, *, dimension: int, modulus: int):
     """[S, 8] seeds -> (sum of masks mod m [dimension] int64, [S] rejected)."""
     masks, rejected = jax.vmap(
         lambda sw: _expand_no_reject(sw, dimension=dimension, modulus=modulus)
     )(seed_matrix)
-    total = jnp.mod(jnp.sum(masks, axis=0, dtype=jnp.int64), modulus)
+    total = _modsum_i64(masks, modulus, axis=0)
     return total, rejected
 
 
@@ -103,6 +128,8 @@ def combine_masks(seeds, dimension: int, modulus: int) -> np.ndarray:
     """Sum of all seeds' expanded masks mod m — the recipient hot loop
     (receive.rs:102-118), every seed's 20-round expansion in parallel lanes.
     Bit-identical to summing chacha.expand_mask per seed."""
+    if modulus <= 0 or modulus >= (1 << 62):
+        raise ValueError("modulus out of range")
     seed_matrix = np.zeros((len(seeds), 8), dtype=np.uint32)
     for i, seed in enumerate(seeds):
         if len(seed) > 8:

@@ -135,7 +135,12 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
     def list_aggregations(self, filter=None, recipient=None):
         query = {}
         if filter is not None:
-            query["title"] = {"$regex": filter}
+            import re
+
+            # escape so this is plain substring matching, same as the
+            # memory/jsonfs/sqlite backends (the reference's raw-$regex
+            # behavior diverges per backend and errors on metacharacters)
+            query["title"] = {"$regex": re.escape(filter)}
         if recipient is not None:
             query["recipient"] = str(recipient)
         return [
@@ -282,10 +287,14 @@ class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
         return None if doc is None else ClerkingJob.from_obj(doc["doc"])
 
     def create_clerking_result(self, result):
-        # atomic done-flag flip: only the first upload stores a result
+        # ONE atomic single-document update sets the result and flips done —
+        # a crash can never consume the job without storing the result (the
+        # reference's clerking_jobs.rs create_clerking_result does the same
+        # single $set; the round-1 two-write version lost the result if it
+        # died between the flip and the separate results-collection insert)
         doc = self.db.clerking_jobs.find_one_and_update(
             {"_id": str(result.job), "clerk": str(result.clerk), "done": False},
-            {"$set": {"done": True}},
+            {"$set": {"done": True, "result": result.to_obj()}},
         )
         if doc is None:
             already = self.db.clerking_jobs.find_one(
@@ -294,25 +303,29 @@ class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
             if already is not None and already.get("done"):
                 return  # duplicate result upload: idempotent
             raise NotFound("job not found for clerk")
-        self.db.clerking_results.replace_one(
-            {"_id": str(result.job)},
-            {
-                "_id": str(result.job),
-                "snapshot": doc["snapshot"],
-                "doc": result.to_obj(),
-            },
-            upsert=True,
-        )
 
     def list_results(self, snapshot):
-        return [
-            ClerkingJobId(d["_id"])
-            for d in self.db.clerking_results.find(
-                {"snapshot": str(snapshot)}).sort("_id", 1)
-        ]
+        ids = {
+            d["_id"]
+            for d in self.db.clerking_jobs.find(
+                {"snapshot": str(snapshot), "done": True,
+                 "result": {"$exists": True}})
+        }
+        # legacy schema (pre-atomic fix): result in its own collection
+        ids.update(
+            d["_id"]
+            for d in self.db.clerking_results.find({"snapshot": str(snapshot)})
+        )
+        return [ClerkingJobId(i) for i in sorted(ids)]
 
     def get_result(self, snapshot, job):
-        doc = self.db.clerking_results.find_one(
+        doc = self.db.clerking_jobs.find_one(
+            {"_id": str(job), "snapshot": str(snapshot),
+             "result": {"$exists": True}}
+        )
+        if doc is not None:
+            return ClerkingResult.from_obj(doc["result"])
+        legacy = self.db.clerking_results.find_one(
             {"_id": str(job), "snapshot": str(snapshot)}
         )
-        return None if doc is None else ClerkingResult.from_obj(doc["doc"])
+        return None if legacy is None else ClerkingResult.from_obj(legacy["doc"])

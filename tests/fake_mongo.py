@@ -28,6 +28,9 @@ def _matches(doc: Dict[str, Any], query: Dict[str, Any]) -> bool:
                 elif op == "$in":
                     if value not in arg:
                         return False
+                elif op == "$exists":
+                    if (field in doc) != bool(arg):
+                        return False
                 else:
                     raise NotImplementedError(f"fake_mongo: operator {op}")
         elif isinstance(value, list) and not isinstance(cond, list):

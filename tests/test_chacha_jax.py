@@ -44,6 +44,24 @@ def test_combine_masks_matches_host_sum():
     np.testing.assert_array_equal(got, exp)
 
 
+def test_combine_masks_large_modulus_no_i64_overflow():
+    """A flat int64 sum of S masks wraps once S*(modulus-1) >= 2^63; the
+    chunked modular fold must stay exact (advisor round-1 finding)."""
+    modulus = (1 << 61) - 1  # 4+ masks of this size overflow a flat i64 sum
+    dimension = 33
+    seeds = [chacha.random_seed(128) for _ in range(9)]
+    got = chacha_jax.combine_masks(seeds, dimension, modulus)
+    exp = np.zeros(dimension, dtype=object)
+    for s in seeds:
+        exp = (exp + chacha.expand_mask(s, dimension, modulus)) % modulus
+    np.testing.assert_array_equal(got, exp.astype(np.int64))
+
+
+def test_combine_masks_rejects_out_of_range_modulus():
+    with pytest.raises(ValueError):
+        chacha_jax.combine_masks([[1]], 4, 1 << 62)
+
+
 def test_native_oracle_agreement():
     """When the C++ kernel is available, all three implementations agree."""
     from sda_tpu import native
