@@ -25,63 +25,17 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
-import sys
 import time
 
-_PROBE_CODE = """
-import jax
-jax.config.update("jax_platforms", "axon")
-ds = jax.devices()
-import jax.numpy as jnp
-x = jnp.ones((256, 256), jnp.float32)
-(x @ x).block_until_ready()
-print("PROBE_OK", ds[0].platform, getattr(ds[0], "device_kind", "?"), flush=True)
-"""
-
-
-def _log(msg: str) -> None:
-    print(f"[bench] {msg}", file=sys.stderr, flush=True)
-
-
-def _probe_tpu(timeout_s: float) -> bool:
-    """Bounded-time TPU liveness check in a subprocess (init can hang)."""
-    for attempt in (1, 2):
-        t0 = time.perf_counter()
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE_CODE],
-                capture_output=True, text=True, timeout=timeout_s,
-            )
-        except subprocess.TimeoutExpired:
-            _log(f"TPU probe attempt {attempt}: timed out after {timeout_s:.0f}s")
-            continue
-        dt = time.perf_counter() - t0
-        if r.returncode == 0 and "PROBE_OK" in r.stdout:
-            _log(f"TPU probe attempt {attempt}: OK in {dt:.1f}s ({r.stdout.strip()})")
-            return True
-        tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
-        _log(
-            f"TPU probe attempt {attempt}: rc={r.returncode} in {dt:.1f}s; "
-            + " | ".join(tail)
-        )
-    return False
-
-
-def _select_platform() -> str:
-    want = os.environ.get("SDA_BENCH_PLATFORM", "auto")
-    if want in ("tpu", "axon"):
-        return "axon"
-    if want == "cpu":
-        return "cpu"
-    timeout_s = float(os.environ.get("SDA_BENCH_TPU_PROBE_TIMEOUT", 300))
-    return "axon" if _probe_tpu(timeout_s) else "cpu"
+from sda_tpu.utils.backend import log as _log
+from sda_tpu.utils.backend import select_platform as _select_platform
+from sda_tpu.utils.backend import use_platform
 
 
 def _run(platform: str, use_pallas: bool) -> dict:
     import jax
 
-    jax.config.update("jax_platforms", platform)
+    use_platform(platform)
 
     import jax.numpy as jnp
     import numpy as np
@@ -110,9 +64,11 @@ def _run(platform: str, use_pallas: bool) -> dict:
     else:
         fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
 
+    # uint32 inputs halve HBM traffic and skip the emulated-s64 residue
+    # pass (_to_residues32 fast path); wire values are < 2^20 anyway
     rng = np.random.default_rng(0)
     inputs = jnp.asarray(
-        rng.integers(0, 1 << 20, size=(participants, dim), dtype=np.int64)
+        rng.integers(0, 1 << 20, size=(participants, dim), dtype=np.uint32)
     )
     key = jax.random.PRNGKey(0)
 
@@ -164,16 +120,12 @@ def main() -> None:
     # produces a measurement wins, and every exit path prints ONE JSON line
     ladder = [(platform, pallas_default), (platform, False), ("cpu", False)]
     attempts = []
-    for rung, (plat, pallas) in enumerate(ladder):
+    for plat, pallas in ladder:
         if attempts and attempts[-1] == (plat, pallas):
             continue
         attempts.append((plat, pallas))
         try:
-            if rung > 0:
-                from jax.extend.backend import clear_backends
-
-                clear_backends()
-            print(json.dumps(_run(plat, pallas)))
+            print(json.dumps(_run(plat, pallas)))  # use_platform clears stale backends
             return
         except Exception as e:
             _log(f"run on {plat!r} (pallas={pallas}) failed: "

@@ -47,6 +47,20 @@ def _scheme(bits=28):
     return PackedShamirSharing(3, 8, t, p, w2, w3)
 
 
+def _on_cpu() -> bool:
+    import jax
+
+    return jax.devices()[0].platform == "cpu"
+
+
+def _cpu_scaled_dim(dim: int, factor: int = 10) -> int:
+    """CPU fallback dims: ~10x smaller (multiple of 3) so the suite
+    completes; the metric string always reports the size actually run."""
+    if not _on_cpu():
+        return dim
+    return max(3, dim // factor // 3 * 3)
+
+
 def bench_readme_walkthrough():
     """Config 1: the reference CLI walkthrough, real crypto + broker."""
     import jax
@@ -107,6 +121,54 @@ def bench_readme_walkthrough():
     }
 
 
+def _phase_breakdown(scheme, inputs, key):
+    """Time each round stage as its own jit (diagnostic; the headline number
+    times the fused round, where XLA overlaps these)."""
+    import jax
+    import jax.numpy as jnp
+    from sda_tpu.fields import fastfield, numtheory, sharing
+
+    s = scheme
+    sp = fastfield.SolinasPrime.try_from(s.prime_modulus)
+    if sp is None:
+        return {}
+    P, d = inputs.shape
+    M_host = numtheory.packed_share_matrix(
+        s.secret_count, s.share_count, s.privacy_threshold,
+        s.prime_modulus, s.omega_secrets, s.omega_shares,
+    )
+    L_host = numtheory.packed_reconstruct_matrix(
+        s.secret_count, s.share_count, s.privacy_threshold,
+        s.prime_modulus, s.omega_secrets, s.omega_shares,
+        tuple(range(s.share_count)),
+    )
+    mask_fn = jax.jit(lambda k: fastfield.uniform32(k, (P, d), sp))
+    share_fn = jax.jit(lambda k, x: sharing.packed_share32(
+        k, x, M_host, sp,
+        secret_count=s.secret_count, privacy_threshold=s.privacy_threshold))
+    combine_fn = jax.jit(lambda sh: fastfield.modsum32(sh, sp, axis=0))
+    recon_fn = jax.jit(lambda c: sharing.packed_reconstruct32(
+        c, L_host, sp, dimension=d))
+
+    x = jax.jit(lambda v: fastfield.to_residues32(v, sp))(inputs)
+    masks = mask_fn(key)
+    shares = share_fn(jax.random.fold_in(key, 1), x)
+    combined = combine_fn(shares)
+
+    def t(fn, *args):
+        jax.block_until_ready(fn(*args))  # warm
+        st = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        return round(time.perf_counter() - st, 4)
+
+    return {
+        "mask_prng_s": t(mask_fn, key),
+        "share_matmul_s": t(share_fn, jax.random.fold_in(key, 1), x),
+        "clerk_combine_s": t(combine_fn, shares),
+        "reconstruct_s": t(recon_fn, combined),
+    }
+
+
 def _round_bench(name, participants, dim, reps=3):
     """Single-chip full-round throughput (configs 2 and 3)."""
     import jax
@@ -116,10 +178,18 @@ def _round_bench(name, participants, dim, reps=3):
 
     scheme = _scheme()
     p = scheme.prime_modulus
-    fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
+    dev = jax.devices()[0]
+    dim = _cpu_scaled_dim(dim)
+    use_pallas = dev.platform != "cpu" and os.environ.get("SDA_PALLAS") == "1"
+    if use_pallas:
+        from sda_tpu.fields.pallas_round import single_chip_round_pallas
+
+        fn = jax.jit(single_chip_round_pallas(scheme, FullMasking(p)))
+    else:
+        fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
     rng = np.random.default_rng(0)
     inputs = jnp.asarray(
-        rng.integers(0, 1 << 20, size=(participants, dim), dtype=np.int64)
+        rng.integers(0, 1 << 20, size=(participants, dim), dtype=np.uint32)
     )
     key = jax.random.PRNGKey(0)
     out = fn(inputs, key)
@@ -143,6 +213,9 @@ def _round_bench(name, participants, dim, reps=3):
         "value": round(participants * dim / best, 1),
         "unit": "shared-elements/sec/chip",
         "round_seconds": round(best, 4),
+        "platform": dev.platform,
+        "pallas": use_pallas,
+        "phases": _phase_breakdown(scheme, inputs, key),
     }
 
 
@@ -156,7 +229,10 @@ def _streaming_bench(name, participants, dim, max_seconds):
     scheme = _scheme()
     p = scheme.prime_modulus
     pc = int(os.environ.get("SDA_BENCH_PART_CHUNK", 64))
-    dc_default = 3 * (1 << 19) if dim > 3 * (1 << 19) else dim
+    # >=1e8-element chunks on TPU amortize dispatch (see ROOFLINE.md on the
+    # round-1 tiny-chunk artifact); CPU uses smaller chunks to fit the budget
+    dc_cap = 3 * (1 << 19) if not _on_cpu() else 3 * (1 << 15)
+    dc_default = dc_cap if dim > dc_cap else dim
     dc = int(os.environ.get("SDA_BENCH_DIM_CHUNK", dc_default))
     agg = StreamingAggregator(
         scheme, FullMasking(p), participants_chunk=pc, dim_chunk=dc
@@ -230,17 +306,40 @@ CONFIGS = {
 
 
 def main():
+    from sda_tpu.utils.backend import select_platform, use_platform
+
+    platform = select_platform()
+    use_platform(platform)
+    import jax
+
+    dev = jax.devices()[0]
+    meta = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+    }
+    print(json.dumps({"suite": meta}), file=sys.stderr, flush=True)
+
     wanted = os.environ.get("SDA_BENCH_CONFIGS")
-    names = wanted.split(",") if wanted else list(CONFIGS)
+    names = [n.strip() for n in wanted.split(",")] if wanted else list(CONFIGS)
+    unknown = [n for n in names if n not in CONFIGS]
+    if unknown:  # fail fast on typos; the except below is for runtime failures
+        raise SystemExit(
+            f"unknown SDA_BENCH_CONFIGS {unknown}; valid: {list(CONFIGS)}"
+        )
     results = []
     for name in names:
-        result = CONFIGS[name.strip()]()
+        try:
+            result = CONFIGS[name.strip()]()
+        except Exception as e:  # record the failure, keep the suite going
+            result = {"config": name.strip(),
+                      "error": f"{type(e).__name__}: {e}"}
+        result.setdefault("platform", dev.platform)
         results.append(result)
         print(json.dumps(result), flush=True)
     out_path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCH_SUITE.json")
     with open(out_path, "w") as f:
-        json.dump({"results": results}, f, indent=2)
+        json.dump({"suite": meta, "results": results}, f, indent=2)
 
 
 if __name__ == "__main__":

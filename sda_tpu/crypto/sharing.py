@@ -102,6 +102,19 @@ class PackedShamirReconstructor(SecretReconstructor):
 
     def reconstruct(self, indexed_shares):
         s = self.scheme
+        # fixed-survivor-count kernel (SURVEY §7d): any quorum of exactly
+        # reconstruction_threshold shares interpolates the same polynomial,
+        # so truncate larger survivor sets — the device matmul then has ONE
+        # shape [r+1, B] per (scheme, dimension) and never recompiles as
+        # clerks drop in and out (round-1 verdict: per-subset re-jits would
+        # compile-storm 80-clerk committees)
+        r = s.reconstruction_threshold
+        if len(indexed_shares) < r:
+            raise ValueError(
+                f"need at least {r} shares to reconstruct, got "
+                f"{len(indexed_shares)}"
+            )
+        indexed_shares = list(indexed_shares)[:r]
         indices = tuple(int(i) for (i, _) in indexed_shares)
         L = jnp.asarray(numtheory.packed_reconstruct_matrix(
             s.secret_count, s.share_count, s.privacy_threshold,

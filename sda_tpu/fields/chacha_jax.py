@@ -89,6 +89,31 @@ def _expand_no_reject(seed_words, *, dimension: int, modulus: int):
     return mask, any_rejected
 
 
+def stream_u64_at(seed_words, counter0, *, dimension: int):
+    """[S, 8] uint32 seeds -> [S, dimension] uint64 stream draws starting at
+    u64-draw offset ``counter0 * 8`` (``dimension % 8 == 0``).
+
+    The windowed form of the CHACHA_PRG_V1 stream for dim-sharded pod mode:
+    each ChaCha block yields 8 u64 draws, so a device holding the dim window
+    [8*c0, 8*c0 + dimension) expands blocks [c0, c0 + dimension/8).
+    ``counter0`` may be traced (it is ``axis_index('d') * blocks_per_shard``
+    under shard_map). Pod mode reduces draws mod m WITHOUT the host spec's
+    rejection step — masks cancel within the round, so the aggregate is
+    exact regardless; only the federated wire path needs rejection parity.
+    """
+    if dimension % 8:
+        raise ValueError("dimension must be a multiple of 8 (one ChaCha block)")
+    nblocks = dimension // 8
+
+    def one(sw):
+        words = chacha_block_words(sw, counter0, nblocks=nblocks).reshape(-1)
+        lo = words[0::2].astype(jnp.uint64)
+        hi = words[1::2].astype(jnp.uint64)
+        return (hi << jnp.uint64(32)) | lo
+
+    return jax.vmap(one)(seed_words)
+
+
 def _modsum_i64(x, modulus: int, axis: int = 0):
     """Overflow-safe modular sum of int64 residues in [0, modulus).
 

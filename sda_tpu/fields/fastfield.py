@@ -86,6 +86,22 @@ def canon32(v, sp: SolinasPrime):
     return jnp.where(r >= np.uint32(sp.p), r - np.uint32(sp.p), r)
 
 
+def to_residues32(inputs, sp: SolinasPrime):
+    """Any-integer inputs -> canonical uint32 residues mod p.
+
+    uint32/int32 non-negative inputs skip the 64-bit pass entirely.
+    """
+    inputs = jnp.asarray(inputs)
+    if inputs.dtype == jnp.uint32:
+        return canon32(inputs, sp)
+    if inputs.dtype == jnp.int32:
+        bits = inputs.astype(jnp.uint32)  # two's complement: negatives ≡ v + 2^32
+        r = canon32(bits, sp)
+        r32 = jnp.uint32((1 << 32) % sp.p)
+        return jnp.where(inputs < 0, modsub32(r, r32, sp), r)
+    return jnp.mod(inputs.astype(jnp.int64), sp.p).astype(jnp.uint32)
+
+
 def modadd32(a, b, sp: SolinasPrime):
     """Canonical a, b -> canonical a+b (sum < 2p < 2^30)."""
     s = a + b
@@ -164,15 +180,8 @@ def uniform32(key, shape, sp: SolinasPrime):
 def modmatmul32(m_host: np.ndarray, v, sp: SolinasPrime):
     """[n, k] host matrix (ints mod p) times canonical [..., k, B] uint32.
 
-    Limb streams with per-stream overflow-safe fan-in (bounds for b <= 29,
-    low limbs < 2^15, high limbs < 2^(b-15) <= 2^14):
-
-      hh = mh*vh < 2^28   (scale 2^30)    hl/lh = *h**l < 2^29 (scale 2^15)
-      ll = ml*vl < 2^30   (scale 1)
-
-    Each stream folds (canonical reduce) whenever another chunk of terms
-    would overflow uint32; the scale-2^30 stream re-enters through
-    ``mulmod32_const(.., 2^30 mod p)``.
+    Builds the matrix limbs host-side (trace-time constants) and contracts
+    via :func:`modmatmul32_limbs`.
     """
     m_host = np.asarray(m_host) % sp.p
     n, k = m_host.shape
@@ -183,6 +192,29 @@ def modmatmul32(m_host: np.ndarray, v, sp: SolinasPrime):
     low_mask = (1 << _LOW) - 1
     mh = jnp.asarray((m_host >> _LOW).astype(np.uint32))     # [n, k] < 2^14
     ml = jnp.asarray((m_host & low_mask).astype(np.uint32))  # [n, k] < 2^15
+    return modmatmul32_limbs(mh, ml, v, sp)
+
+
+def modmatmul32_limbs(mh, ml, v, sp: SolinasPrime):
+    """Core contraction on pre-split matrix limbs (device arrays).
+
+    ``mh``/``ml``: [n, k] uint32 high/low 15-bit limbs of a matrix of
+    canonical residues; ``v``: canonical [..., k, B] uint32. Split out from
+    :func:`modmatmul32` so Pallas kernels can take the limbs as inputs
+    (kernels may not capture traced constants).
+
+    Limb streams with per-stream overflow-safe fan-in (bounds for b <= 29,
+    low limbs < 2^15, high limbs < 2^(b-15) <= 2^14):
+
+      hh = mh*vh < 2^28   (scale 2^30)    hl/lh = *h**l < 2^29 (scale 2^15)
+      ll = ml*vl < 2^30   (scale 1)
+
+    Each stream folds (canonical reduce) whenever another chunk of terms
+    would overflow uint32; the scale-2^30 stream re-enters through
+    ``mulmod32_const(.., 2^30 mod p)``.
+    """
+    n, k = mh.shape
+    low_mask = (1 << _LOW) - 1
     vh = v >> np.uint32(_LOW)                                # [..., k, B] < 2^14
     vl = v & np.uint32(low_mask)                             # [..., k, B] < 2^15
 

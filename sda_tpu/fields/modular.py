@@ -41,12 +41,32 @@ def modsub(a, b, m):
 
 
 def modsum(x, m, axis=0):
-    """Sum of canonical residues along ``axis`` mod m.
+    """Sum of canonical residues along ``axis`` mod m — THE clerk kernel
+    (reference hot loop: sharing/combiner.rs:15-30).
 
-    Safe while n_terms * m < 2^63 (n < 2^32 for the largest 31-bit moduli) —
-    this is THE clerk kernel (reference hot loop: sharing/combiner.rs:15-30).
+    Exact for any m < 2^62 and any term count: when a flat int64 sum could
+    wrap (n_terms * (m-1) >= 2^63, e.g. 8 shares of a 2^61 modulus), the
+    reduction folds in chunks small enough that every partial sum provably
+    fits, canonicalizing between levels. For m < 2^31 the fan exceeds any
+    realistic axis and this is a single plain sum.
     """
-    return jnp.mod(jnp.sum(x, axis=axis, dtype=jnp.int64), m)
+    x = jnp.asarray(x, jnp.int64)
+    n = x.shape[axis]
+    fan = max(2, ((1 << 63) - 1) // max(1, int(m) - 1))
+    if n <= fan:
+        return jnp.mod(jnp.sum(x, axis=axis, dtype=jnp.int64), m)
+    x = jnp.moveaxis(x, axis, 0)
+    while x.shape[0] > 1:
+        k = x.shape[0]
+        chunk = min(fan, k)
+        pad = (-k) % chunk
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + x.shape[1:], jnp.int64)], axis=0
+            )
+        x = x.reshape((x.shape[0] // chunk, chunk) + x.shape[1:])
+        x = jnp.mod(jnp.sum(x, axis=1, dtype=jnp.int64), m)
+    return x[0]
 
 
 #: Largest supported modulus (exclusive): residues must fit 31 bits so
@@ -134,4 +154,14 @@ def np_modmatmul(a: np.ndarray, b: np.ndarray, p: int) -> np.ndarray:
 
 
 def np_modsum(x: np.ndarray, m: int, axis=0) -> np.ndarray:
-    return np.sum(np.asarray(x, dtype=np.int64), axis=axis) % m
+    x = np.asarray(x, dtype=np.int64)
+    n = x.shape[axis]
+    fan = max(2, ((1 << 63) - 1) // max(1, int(m) - 1))
+    if n <= fan:
+        return np.sum(x, axis=axis) % m
+    x = np.moveaxis(x, axis, 0)
+    acc = np.zeros(x.shape[1:], dtype=np.int64)
+    for start in range(0, n, fan):
+        part = np.sum(x[start : start + fan], axis=0) % m
+        acc = (acc + part) % m  # both canonical: sum < 2m < 2^63
+    return acc

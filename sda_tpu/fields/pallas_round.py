@@ -54,7 +54,12 @@ def _uniform_from_bits(hi_bits, lo_bits, sp: SolinasPrime):
 
 
 def _share_rows_const(values_rows, m_host_row, sp: SolinasPrime):
-    """Sum_j M[i][j]*values[j] for one output row, all constants."""
+    """Sum_j M[i][j]*values[j] for one output row, all constants.
+
+    Kept for reference/AB-testing: operates on [1, tile] row slices, which
+    uses 1 of 8 VPU sublanes; the default kernel path calls
+    fastfield.modmatmul32 on the full [m2-1, tile] block instead.
+    """
     acc = None
     for coeff, row in zip(m_host_row, values_rows):
         if coeff % sp.p == 0:
@@ -94,13 +99,11 @@ def fused_mask_share_combine(
     draws = (k + t) if masked else t
     internal = external_bits is None
 
-    m_rows = [[int(v) for v in row] for row in np.asarray(m_host)]
-
     def kernel(*refs):
         if internal:
-            seed_ref, x_ref, shares_ref, masktot_ref = refs
+            seed_ref, x_ref, mh_ref, ml_ref, shares_ref, masktot_ref = refs
         else:
-            seed_ref, x_ref, bits_ref, shares_ref, masktot_ref = refs
+            seed_ref, x_ref, mh_ref, ml_ref, bits_ref, shares_ref, masktot_ref = refs
         if internal:
             pltpu.prng_seed(seed_ref[0], pl.program_id(0))
 
@@ -126,26 +129,32 @@ def fused_mask_share_combine(
             else:
                 values_k = x_p
                 rand = draw((t, tile), 0, p_ix)
-            # rows of the values column vector, minus the fixed zero row
-            # (share matrix column 0 multiplies 0); kept 2D [1, TB]
-            rows = [values_k[j : j + 1, :] for j in range(k)] + [
-                rand[j : j + 1, :] for j in range(t)
-            ]
-            for i in range(n):
-                contrib = _share_rows_const(rows, m_rows[i][1:], sp)
-                shares_ref[i : i + 1, :] = modadd32(
-                    shares_ref[i : i + 1, :], contrib, sp
-                )
+            values = jnp.concatenate([values_k, rand], axis=0)    # [k+t, TB]
+            # full-block limb-stream matmul: all n share rows at once, all
+            # 8 sublanes live (vs the old per-row [1, TB] const-mul loop)
+            contrib = fastfield.modmatmul32_limbs(
+                mh_ref[...], ml_ref[...], values, sp
+            )                                                     # [n, TB]
+            shares_ref[...] = modadd32(shares_ref[...], contrib, sp)
             return 0
 
         jax.lax.fori_loop(0, P, body, 0)
+
+    # host-side limb split of the active share-matrix columns (minus the
+    # fixed zero column 0); tiny [n, m2-1] blocks, same in every grid step
+    m_active = np.asarray(m_host)[:, 1:] % sp.p
+    mh_np = (m_active >> 15).astype(np.uint32)
+    ml_np = (m_active & 0x7FFF).astype(np.uint32)
 
     grid = (B // tile,)
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),                     # seed
         pl.BlockSpec((P, k, tile), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec(mh_np.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec(ml_np.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
     ]
-    args = [jnp.asarray([seed], jnp.int32), x_cols]
+    args = [jnp.asarray([seed], jnp.int32), x_cols,
+            jnp.asarray(mh_np), jnp.asarray(ml_np)]
     if not internal:
         in_specs.append(
             pl.BlockSpec((P, 2 * draws, tile), lambda i: (0, 0, i),
@@ -173,7 +182,7 @@ def fused_mask_share_combine(
 def single_chip_round_pallas(
     sharing_scheme,
     masking_scheme=None,
-    tile: int = 512,
+    tile: Optional[int] = None,
     interpret: bool = False,
     external_bits_fn=None,
 ):
@@ -208,13 +217,16 @@ def single_chip_round_pallas(
     draws = (k + t) if masked else t
 
     def round_fn(inputs, key):
-        from ..mesh.simpod import _to_residues32
-
         P, d = inputs.shape
-        x = _to_residues32(inputs, sp)
+        x = fastfield.to_residues32(inputs, sp)
         x_cols = batch_columns(x, k)                               # [P, k, B0]
         B0 = x_cols.shape[-1]
-        pad = (-B0) % tile
+        # lane-dim tile: multiples of 128 lanes; large tiles amortize the
+        # grid-step overhead, small B avoids padding waste
+        TB = tile if tile is not None else (
+            1024 if B0 >= 1024 else max(128, -(-B0 // 128) * 128)
+        )
+        pad = (-B0) % TB
         if pad:
             x_cols = jnp.pad(x_cols, ((0, 0), (0, 0), (0, pad)))
         B = B0 + pad
@@ -224,7 +236,7 @@ def single_chip_round_pallas(
             ext = external_bits_fn(key, P, draws, B)
         shares, mask_tot = fused_mask_share_combine(
             x_cols, seed, sp, m_host, t, masked,
-            tile=tile, external_bits=ext, interpret=interpret,
+            tile=TB, external_bits=ext, interpret=interpret,
         )
         from .sharing import packed_reconstruct32
 

@@ -39,6 +39,7 @@ import json
 import logging
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -119,6 +120,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+        # per-request status line + counters (reference: the rouille wrapper
+        # logs method/path/status per request, server-http/src/lib.rs:105-122)
+        dt_ms = (time.perf_counter() - self._t0) * 1e3 if self._t0 else 0.0
+        log.info("%s %s -> %d (%.1fms)", self.command, self.path, status, dt_ms)
+        counts = getattr(self.server, "status_counts", None)
+        if counts is not None:
+            with self.server.stats_lock:  # type: ignore[attr-defined]
+                counts[status] = counts.get(status, 0) + 1
 
     def _reply_option(self, obj):
         if obj is None:
@@ -126,8 +135,11 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(200, obj.to_obj())
 
+    _t0 = 0.0
+
     # -- dispatch ----------------------------------------------------------
     def _route(self, method: str):
+        self._t0 = time.perf_counter()
         url = urlparse(self.path)
         path = url.path.rstrip("/")
         query = parse_qs(url.query)
@@ -298,7 +310,15 @@ class SdaHttpServer:
         host, _, port = bind.partition(":")
         self.httpd = ThreadingHTTPServer((host, int(port or 8888)), _Handler)
         self.httpd.sda_service = service  # type: ignore[attr-defined]
+        self.httpd.status_counts = {}  # type: ignore[attr-defined]
+        self.httpd.stats_lock = threading.Lock()  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def status_counts(self) -> dict:
+        """Requests served, keyed by HTTP status (observability floor)."""
+        with self.httpd.stats_lock:  # type: ignore[attr-defined]
+            return dict(self.httpd.status_counts)  # type: ignore[attr-defined]
 
     @property
     def address(self) -> str:

@@ -2,6 +2,7 @@
 
 from .simpod import SimulatedPod, default_mesh_shape, make_mesh, single_chip_round
 from .streaming import (
+    StreamedPod,
     StreamingAggregator,
     array_block_provider,
     synthetic_block_provider,

@@ -15,15 +15,24 @@ Mesh axes and their protocol meaning (SURVEY.md §2.4 mapping):
 
 Dataflow per round, per (p, d) device:
 
-1. mask + share the local [P/p, d/d'] participant block (threefry per
-   participant, share matmul on the local dim chunk);
+1. mask + share the local [P/p, d/d'] participant block (threefry or
+   device-ChaCha per participant, share matmul on the local dim chunk);
 2. sum local participants' shares — participant parallelism is a *local*
    reduction;
 3. ``psum_scatter`` over ``p`` splits the clerk axis while summing across
    participant shards — this one collective IS the snapshot transpose plus
    every clerk's combine, riding ICI instead of the broker;
 4. ``all_gather`` over ``p`` hands the recipient all clerk rows; the
-   reconstruct matmul and unmask run dim-sharded.
+   reconstruct (Lagrange matmul for packed Shamir, share-sum for additive)
+   and unmask run dim-sharded.
+
+Scheme coverage matches the reference's full pluggability
+(client/src/crypto/masking/mod.rs:33-94, sharing/mod.rs:35-96): sharing is
+Packed-Shamir OR additive; masking is None, Full, or ChaCha (seed-
+compressed masks expanded on device at each shard's dim offset,
+fields/chacha_jax.py). Inputs are auto-padded to the mesh/scheme grain:
+zero participants and zero components aggregate as zero and are stripped
+from the output.
 
 Trust model: this mode computes the same algebra with the same scheme
 parameters but no transport encryption (devices of one pod trust each
@@ -33,7 +42,6 @@ federated HTTP mode keeps sealed boxes.
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional, Tuple
 
@@ -42,38 +50,54 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..fields import fastfield, modular, numtheory, sharing
+from ..fields import chacha_jax, fastfield, numtheory, sharing
+from ..fields.ops import FieldOps
 from ..utils import timed_phase
-
-
-def _to_residues32(inputs, sp: fastfield.SolinasPrime):
-    """Any-integer inputs -> canonical uint32 residues mod p.
-
-    uint32/int32 non-negative inputs skip the 64-bit pass entirely.
-    """
-    if inputs.dtype == jnp.uint32:
-        return fastfield.canon32(inputs, sp)
-    if inputs.dtype == jnp.int32:
-        bits = inputs.astype(jnp.uint32)  # two's complement: negatives ≡ v + 2^32
-        r = fastfield.canon32(bits, sp)
-        r32 = jnp.uint32((1 << 32) % sp.p)
-        return jnp.where(inputs < 0, fastfield.modsub32(r, r32, sp), r)
-    return jnp.mod(inputs.astype(jnp.int64), sp.p).astype(jnp.uint32)
 from ..protocol import (
+    AdditiveSharing,
+    ChaChaMasking,
     FullMasking,
     LinearMaskingScheme,
+    LinearSecretSharingScheme,
     NoMasking,
     PackedShamirSharing,
 )
 
 
+# re-export: lives in fields.fastfield (pure field arithmetic); kept under
+# the old name for existing importers
+_to_residues32 = fastfield.to_residues32
+
+
+def _scheme_modulus(scheme: LinearSecretSharingScheme) -> int:
+    if isinstance(scheme, PackedShamirSharing):
+        return scheme.prime_modulus
+    if isinstance(scheme, AdditiveSharing):
+        return scheme.modulus
+    raise ValueError(f"unsupported sharing scheme {type(scheme).__name__}")
+
+
 def _check_mask_modulus(masking, scheme) -> None:
     # the mask/unmask algebra only cancels when masking and sharing operate
     # in the same group
-    if isinstance(masking, FullMasking) and masking.modulus != scheme.prime_modulus:
+    mask_mod = getattr(masking, "modulus", None)
+    if mask_mod is not None and mask_mod != _scheme_modulus(scheme):
         raise ValueError(
-            f"masking modulus {masking.modulus} != sharing prime "
-            f"{scheme.prime_modulus}: masks would not cancel"
+            f"masking modulus {mask_mod} != sharing modulus "
+            f"{_scheme_modulus(scheme)}: masks would not cancel"
+        )
+
+
+def _check_collective_headroom(field: FieldOps, p_shards: int) -> None:
+    """psum/psum_scatter add ``p_shards`` canonical residues before the next
+    canonicalize; the int64 path cannot chunk inside a collective, so the
+    bound must hold up front (the uint32 path's bound is enforced by
+    FieldOps.create falling back to int64)."""
+    if field.sp is None and p_shards * (field.m - 1) >= (1 << 63):
+        raise ValueError(
+            f"modulus {field.m} too large for {p_shards}-way participant "
+            f"shards: cross-shard sums would overflow int64 — use fewer "
+            f"p shards or a smaller modulus"
         )
 
 
@@ -91,177 +115,222 @@ def default_mesh_shape(n_devices: int, share_count: int) -> Tuple[int, int]:
     return p_shards, n_devices // p_shards
 
 
+# ---------------------------------------------------------------------------
+# Round stages, shared by the SPMD pod body and the single-chip round.
+# Every function takes canonical residues in the FieldOps working dtype.
+
+#: fold_in tag separating the ChaCha-seed key stream from share randomness
+_SEED_TAG = 0x5EED
+
+
+def _chacha_seed_words(key, global_ids, seed_bitsize: int):
+    """[S] global participant ids -> [S, 8] uint32 seed words.
+
+    The seed depends only on (round key, participant id) — every dim shard
+    of one participant derives the SAME seed and expands disjoint windows
+    of one stream, which is the whole point of seed-compressed masks.
+    Words beyond ceil(seed_bitsize/32) are zero, matching the host spec's
+    zero-padded ChaCha key (fields/chacha.py).
+    """
+    seed_key = jax.random.fold_in(key, _SEED_TAG)
+    words = (int(seed_bitsize) + 31) // 32
+    if words > 8:
+        raise ValueError("seed_bitsize > 256 unsupported")
+
+    def one(i):
+        w = jax.random.bits(jax.random.fold_in(seed_key, i), (8,), jnp.uint32)
+        keep = (jnp.arange(8) < words)
+        return jnp.where(keep, w, jnp.uint32(0))
+
+    return jax.vmap(one)(global_ids)
+
+
+def _mask_stage(masking, f: FieldOps, x, key, round_key, pid_base, d_block0):
+    """-> (masked [S, d_loc], local_mask_sum [d_loc] or None, share_key).
+
+    ``pid_base``: global id of the first local participant row (ChaCha
+    seeds are a function of (round key, global participant id) only).
+    ``d_block0``: ChaCha block counter at this shard's dim offset
+    (= global_dim_offset / 8). Both may be traced.
+    """
+    S, d_loc = x.shape
+    if isinstance(masking, FullMasking):
+        mkey, skey = jax.random.split(key)
+        masks = f.uniform(mkey, (S, d_loc))
+    elif isinstance(masking, ChaChaMasking):
+        skey = key
+        gids = pid_base + jnp.arange(S)
+        seeds = _chacha_seed_words(round_key, gids, masking.seed_bitsize)
+        draws = chacha_jax.stream_u64_at(seeds, d_block0, dimension=d_loc)
+        masks = f.from_u64(draws)
+    else:
+        return x, None, key
+    masked = f.add(x, masks)
+    return masked, f.sum(masks, axis=0), skey
+
+
+def _share_stage(scheme, f: FieldOps, M_host, masked, skey):
+    """[S, d_loc] masked residues -> [S, n, B] per-participant share rows."""
+    if isinstance(scheme, PackedShamirSharing):
+        if f.sp is not None:
+            return sharing.packed_share32(
+                skey, masked, M_host, f.sp,
+                secret_count=scheme.secret_count,
+                privacy_threshold=scheme.privacy_threshold,
+            )
+        return sharing.packed_share(
+            skey, masked, jnp.asarray(M_host),
+            prime=scheme.prime_modulus,
+            secret_count=scheme.secret_count,
+            privacy_threshold=scheme.privacy_threshold,
+        )
+    # additive: n-1 uniform draws, last share = masked - sum(draws)
+    # (reference: sharing/additive.rs:32-52); B == d_loc (input_size 1)
+    S, d_loc = masked.shape
+    n = scheme.share_count
+    draws = f.uniform(skey, (S, n - 1, d_loc))
+    last = f.sub(masked, f.sum(draws, axis=-2))
+    return jnp.concatenate([draws, last[:, None, :]], axis=1)
+
+
+def _reconstruct_stage(scheme, f: FieldOps, L_host, gathered, d_loc: int):
+    """[n, B] clerk rows -> [d_loc] masked totals."""
+    if isinstance(scheme, PackedShamirSharing):
+        if f.sp is not None:
+            return sharing.packed_reconstruct32(
+                gathered, L_host, f.sp, dimension=d_loc
+            )
+        return sharing.packed_reconstruct(
+            gathered, jnp.asarray(L_host),
+            prime=scheme.prime_modulus, dimension=d_loc,
+        )
+    return f.sum(gathered, axis=0)  # additive: plain share sum
+
+
+def _dim_grain(scheme, masking) -> int:
+    """Smallest dim-chunk size a single device can hold: packing width,
+    times the ChaCha block width when masks are stream-expanded."""
+    grain = scheme.input_size
+    if isinstance(masking, ChaChaMasking):
+        grain = math.lcm(grain, 8)
+    return grain
+
+
+def _build_matrices(scheme):
+    if not isinstance(scheme, PackedShamirSharing):
+        return None, None
+    s = scheme
+    M = numtheory.packed_share_matrix(
+        s.secret_count, s.share_count, s.privacy_threshold,
+        s.prime_modulus, s.omega_secrets, s.omega_shares,
+    )
+    L = numtheory.packed_reconstruct_matrix(
+        s.secret_count, s.share_count, s.privacy_threshold,
+        s.prime_modulus, s.omega_secrets, s.omega_shares,
+        tuple(range(s.share_count)),
+    )
+    return M, L
+
+
 class SimulatedPod:
     """One secure-aggregation round as a single SPMD program.
 
-    Requires: committee size divisible by the ``p`` axis, participants
-    divisible by ``p``, dimension divisible by ``secret_count * d_shards``
-    (pad inputs to fit — zero participants/components aggregate as zero).
+    Committee size must be divisible by the ``p`` axis; participant and
+    dimension counts are auto-padded to the mesh/scheme grain (zero rows
+    and components aggregate as zero; padding is stripped from the output).
     """
 
     def __init__(
         self,
-        sharing_scheme: PackedShamirSharing,
+        sharing_scheme: LinearSecretSharingScheme,
         masking_scheme: Optional[LinearMaskingScheme] = None,
         mesh: Optional[Mesh] = None,
     ):
-        if not isinstance(sharing_scheme, PackedShamirSharing):
-            raise ValueError("SimulatedPod currently runs Packed-Shamir rounds")
         self.scheme = sharing_scheme
+        self.modulus = _scheme_modulus(sharing_scheme)
         self.masking = masking_scheme or NoMasking()
-        if not isinstance(self.masking, (NoMasking, FullMasking)):
-            raise ValueError("simulated-pod masking: None or Full (seed PRGs are host-side)")
+        if not isinstance(self.masking, (NoMasking, FullMasking, ChaChaMasking)):
+            raise ValueError(
+                f"unsupported masking scheme {type(self.masking).__name__}"
+            )
         _check_mask_modulus(self.masking, sharing_scheme)
         if mesh is None:
             p_shards, d_shards = default_mesh_shape(
-                len(jax.devices()), sharing_scheme.share_count
+                len(jax.devices()), sharing_scheme.output_size
             )
             mesh = make_mesh(p_shards, d_shards)
         self.mesh = mesh
         p_shards = mesh.devices.shape[0]
-        if sharing_scheme.share_count % p_shards:
+        if sharing_scheme.output_size % p_shards:
             raise ValueError(
-                f"committee size {sharing_scheme.share_count} must be divisible "
+                f"committee size {sharing_scheme.output_size} must be divisible "
                 f"by the p axis ({p_shards})"
             )
-        s = sharing_scheme
-        self._M_host = numtheory.packed_share_matrix(
-            s.secret_count, s.share_count, s.privacy_threshold,
-            s.prime_modulus, s.omega_secrets, s.omega_shares,
-        )
-        self._L_host = numtheory.packed_reconstruct_matrix(
-            s.secret_count, s.share_count, s.privacy_threshold,
-            s.prime_modulus, s.omega_secrets, s.omega_shares,
-            tuple(range(s.share_count)),
-        )
-        self._M = jnp.asarray(self._M_host)
-        self._L = jnp.asarray(self._L_host)
-        # uint32 fast path: Solinas prime AND cross-shard sums can't wrap u32
-        sp = fastfield.SolinasPrime.try_from(s.prime_modulus)
-        if sp is not None and p_shards * (s.prime_modulus - 1) >= (1 << 32):
-            sp = None
-        self._sp = sp
+        self._M_host, self._L_host = _build_matrices(sharing_scheme)
+        # cross-shard share/mask sums ride collectives between canonicalizes
+        self._field = FieldOps.create(self.modulus, cross_terms=p_shards)
+        _check_collective_headroom(self._field, p_shards)
         self._step = None
         self._step_shape = None
 
+    @property
+    def _sp(self):
+        """Solinas parameters when the uint32 fast path is active, else None."""
+        return self._field.sp
+
     # ------------------------------------------------------------------
-    def _local_round_fast(self, inputs, key):
-        """uint32 Solinas body under shard_map: inputs [P_loc, d_loc].
-
-        Identical dataflow to ``_local_round`` (same collectives over the
-        same axes) with all field math on the fast path; cross-shard sums
-        ride the collectives in uint32 (bounded: p_shards * (p-1) < 2^32,
-        checked in __init__) and are canonicalized on arrival.
-        """
-        s = self.scheme
-        sp = self._sp
-        P_loc, d_loc = inputs.shape
-        pi = jax.lax.axis_index("p")
-        di = jax.lax.axis_index("d")
-        key = jax.random.fold_in(jax.random.fold_in(key, pi), di)
-
-        x = _to_residues32(inputs, sp)
-        if isinstance(self.masking, FullMasking):
-            mkey, skey = jax.random.split(key)
-            masks = fastfield.uniform32(mkey, (P_loc, d_loc), sp)
-            masked = fastfield.modadd32(x, masks, sp)
-            local_mask_sum = fastfield.modsum32(masks, sp, axis=0)     # [d_loc]
-        else:
-            skey = key
-            masked = x
-            local_mask_sum = None
-
-        shares = sharing.packed_share32(
-            skey, masked, self._M_host, sp,
-            secret_count=s.secret_count, privacy_threshold=s.privacy_threshold,
-        )                                                              # [P_loc, n, B_loc]
-        local_sum = fastfield.modsum32(shares, sp, axis=0)             # [n, B_loc]
-
-        clerk_rows = jax.lax.psum_scatter(
-            local_sum, "p", scatter_dimension=0, tiled=True
-        )                                                              # [n/p, B_loc]
-        clerk_rows = fastfield.canon32(clerk_rows, sp)
-
-        gathered = jax.lax.all_gather(clerk_rows, "p", axis=0, tiled=True)
-
-        masked_total = sharing.packed_reconstruct32(
-            gathered, self._L_host, sp, dimension=d_loc
-        )                                                              # [d_loc]
-
-        if local_mask_sum is None:
-            return masked_total.astype(jnp.int64)
-        mask_total = fastfield.canon32(jax.lax.psum(local_mask_sum, "p"), sp)
-        return fastfield.modsub32(masked_total, mask_total, sp).astype(jnp.int64)
-
     def _local_round(self, inputs, key):
         """Per-device body under shard_map: inputs [P_loc, d_loc]."""
-        s = self.scheme
-        p = s.prime_modulus
-        mod = self.masking.modulus if isinstance(self.masking, FullMasking) else p
+        f = self._field
         P_loc, d_loc = inputs.shape
         pi = jax.lax.axis_index("p")
         di = jax.lax.axis_index("d")
-        # distinct randomness per device block
-        key = jax.random.fold_in(jax.random.fold_in(key, pi), di)
+        # distinct randomness per device block; ChaCha seeds fold the raw
+        # round key so every dim shard derives the same per-participant seed
+        dev_key = jax.random.fold_in(jax.random.fold_in(key, pi), di)
 
-        if isinstance(self.masking, FullMasking):
-            mkey, skey = jax.random.split(key)
-            masks = modular.uniform_mod(mkey, (P_loc, d_loc), mod)
-            masked = modular.modadd(inputs, masks, mod)
-            local_mask_sum = modular.modsum(masks, mod, axis=0)        # [d_loc]
-        else:
-            skey = key
-            masked = modular.canon(inputs, p)  # kernels need residues in [0, p)
-            local_mask_sum = jnp.zeros((d_loc,), jnp.int64)
-
-        # share each local participant's dim chunk: [P_loc, n, B_loc]
-        B_loc = d_loc // s.secret_count
-        shares = sharing.packed_share(
-            skey, masked, self._M,
-            prime=p, secret_count=s.secret_count, privacy_threshold=s.privacy_threshold,
+        x = f.to_residues(inputs)
+        masked, local_mask_sum, skey = _mask_stage(
+            self.masking, f, x, dev_key, key,
+            pid_base=pi * P_loc, d_block0=di * (d_loc // 8),
         )
 
+        shares = _share_stage(self.scheme, f, self._M_host, masked, skey)
+
         # participant parallelism -> local reduction
-        local_sum = modular.modsum(shares, p, axis=0)                  # [n, B_loc]
+        local_sum = f.sum(shares, axis=0)                          # [n, B_loc]
 
         # snapshot transpose + clerk combine == one psum_scatter over ICI:
         # clerk axis is split across 'p' while partial sums are combined
         clerk_rows = jax.lax.psum_scatter(
             local_sum, "p", scatter_dimension=0, tiled=True
-        )                                                              # [n/p, B_loc]
-        clerk_rows = jnp.mod(clerk_rows, p)
+        )                                                          # [n/p, B_loc]
+        clerk_rows = f.canon(clerk_rows)
 
         # recipient gathers all clerk rows (clerk -> recipient leg)
-        gathered = jax.lax.all_gather(
-            clerk_rows, "p", axis=0, tiled=True
-        )                                                              # [n, B_loc]
+        gathered = jax.lax.all_gather(clerk_rows, "p", axis=0, tiled=True)
 
-        # reconstruct on the local dim chunk
-        masked_total = sharing.packed_reconstruct(
-            gathered, self._L, prime=p, dimension=d_loc
-        )                                                              # [d_loc]
+        masked_total = _reconstruct_stage(
+            self.scheme, f, self._L_host, gathered, d_loc
+        )                                                          # [d_loc]
 
-        # unmask: combine mask across participant shards
-        mask_total = jax.lax.psum(local_mask_sum, "p")
-        if isinstance(self.masking, FullMasking):
-            mask_total = jnp.mod(mask_total, mod)
-            out = modular.modsub(masked_total, mask_total, mod)
-        else:
-            out = masked_total
-        return out                                                     # [d_loc]
+        if local_mask_sum is None:
+            return f.to_int64(masked_total)
+        mask_total = f.canon(jax.lax.psum(local_mask_sum, "p"))
+        return f.to_int64(f.sub(masked_total, mask_total))
 
     def _build(self, P_total: int, d_total: int):
-        s = self.scheme
         p_shards, d_shards = self.mesh.devices.shape
         if P_total % p_shards:
             raise ValueError(f"participants {P_total} not divisible by p axis {p_shards}")
-        if d_total % (s.secret_count * d_shards):
+        grain = _dim_grain(self.scheme, self.masking) * d_shards
+        if d_total % grain:
             raise ValueError(
-                f"dimension {d_total} must be divisible by secret_count*d_shards "
-                f"= {s.secret_count * d_shards}"
+                f"dimension {d_total} must be divisible by the scheme/mesh "
+                f"grain {grain}"
             )
         fn = jax.shard_map(
-            self._local_round_fast if self._sp is not None else self._local_round,
+            self._local_round,
             mesh=self.mesh,
             in_specs=(P("p", "d"), P()),
             out_specs=P("d"),
@@ -269,14 +338,30 @@ class SimulatedPod:
         )
         return jax.jit(fn)
 
+    def padded_shape(self, P_total: int, d_total: int) -> Tuple[int, int]:
+        p_shards, d_shards = self.mesh.devices.shape
+        grain = _dim_grain(self.scheme, self.masking) * d_shards
+        return (
+            -(-P_total // p_shards) * p_shards,
+            -(-d_total // grain) * grain,
+        )
+
     def aggregate(self, inputs, key=None):
         """[P, d] participant inputs -> [d] aggregate (one full round)."""
-        inputs = jnp.asarray(inputs, dtype=jnp.int64)
+        inputs = np.asarray(inputs)
         if key is None:
             from ..crypto.core import fresh_prng_key
 
             key = fresh_prng_key()
-        shape = tuple(inputs.shape)
+        P_total, d_total = inputs.shape
+        P_pad, d_pad = self.padded_shape(P_total, d_total)
+        if (P_pad, d_pad) != (P_total, d_total):
+            # zero participants/components aggregate as zero (masks on the
+            # padding cancel like any other mask); strip below
+            padded = np.zeros((P_pad, d_pad), dtype=inputs.dtype)
+            padded[:P_total, :d_total] = inputs
+            inputs = padded
+        shape = (P_pad, d_pad)
         if self._step is None or self._step_shape != shape:
             self._step = self._build(*shape)
             self._step_shape = shape
@@ -284,18 +369,19 @@ class SimulatedPod:
         # first round per shape includes jit compilation (jax.jit is lazy):
         # it shows in the phase stats as max_s >> min_s
         with timed_phase("mesh.round"):
-            inputs = jax.device_put(inputs, sharding)
-            out = self._step(inputs, key)
+            device_inputs = jax.device_put(jnp.asarray(inputs), sharding)
+            out = self._step(device_inputs, key)
             out.block_until_ready()
-        return out
+        return out[:d_total]
 
     def aggregate_fn(self, P_total: int, d_total: int):
-        """The raw jitted SPMD round for benchmarking/compile checks."""
+        """The raw jitted SPMD round for benchmarking/compile checks
+        (shapes must already satisfy the mesh/scheme grain)."""
         return self._build(P_total, d_total)
 
 
 def single_chip_round(
-    sharing_scheme: PackedShamirSharing,
+    sharing_scheme: LinearSecretSharingScheme,
     masking_scheme: Optional[LinearMaskingScheme] = None,
 ):
     """Collective-free full aggregation round, jittable on one device.
@@ -303,76 +389,31 @@ def single_chip_round(
     Same algebra as SimulatedPod (mask -> share -> combine -> reconstruct ->
     unmask) with the committee resident on a single chip — the flagship
     single-chip "forward step" and the unit benchmark kernel. For Solinas
-    primes (the generator's preference) the whole round runs on the uint32
-    fast path (fields.fastfield); results are bit-identical either way.
+    moduli the whole round runs on the uint32 fast path (fields.fastfield);
+    results are bit-identical either way. ChaCha masking requires the
+    dimension to be a multiple of 8 (one ChaCha block).
     """
-    s = sharing_scheme
+    scheme = sharing_scheme
     masking = masking_scheme or NoMasking()
-    if not isinstance(masking, (NoMasking, FullMasking)):
-        raise ValueError("single_chip_round masking: None or Full")
-    _check_mask_modulus(masking, s)
-    p = s.prime_modulus
-    M_host = numtheory.packed_share_matrix(
-        s.secret_count, s.share_count, s.privacy_threshold,
-        p, s.omega_secrets, s.omega_shares,
-    )
-    L_host = numtheory.packed_reconstruct_matrix(
-        s.secret_count, s.share_count, s.privacy_threshold,
-        p, s.omega_secrets, s.omega_shares, tuple(range(s.share_count)),
-    )
-
-    sp = fastfield.SolinasPrime.try_from(p)
-    if sp is not None:
-
-        def round_fn(inputs, key):
-            P_total, d = inputs.shape
-            x = _to_residues32(inputs, sp)
-            if isinstance(masking, FullMasking):
-                mkey, skey = jax.random.split(key)
-                masks = fastfield.uniform32(mkey, (P_total, d), sp)
-                masked = fastfield.modadd32(x, masks, sp)
-                mask_total = fastfield.modsum32(masks, sp, axis=0)
-            else:
-                skey = key
-                masked = x
-                mask_total = None
-            shares = sharing.packed_share32(
-                skey, masked, M_host, sp,
-                secret_count=s.secret_count, privacy_threshold=s.privacy_threshold,
-            )                                                  # [P, n, B]
-            combined = fastfield.modsum32(shares, sp, axis=0)  # clerk combine
-            masked_total = sharing.packed_reconstruct32(
-                combined, L_host, sp, dimension=d
-            )
-            if mask_total is None:
-                return masked_total.astype(jnp.int64)
-            return fastfield.modsub32(masked_total, mask_total, sp).astype(jnp.int64)
-
-        return round_fn
-
-    M = jnp.asarray(M_host)
-    L = jnp.asarray(L_host)
+    if not isinstance(masking, (NoMasking, FullMasking, ChaChaMasking)):
+        raise ValueError(
+            f"unsupported masking scheme {type(masking).__name__}"
+        )
+    _check_mask_modulus(masking, scheme)
+    M_host, L_host = _build_matrices(scheme)
+    f = FieldOps.create(_scheme_modulus(scheme))
 
     def round_fn(inputs, key):
         P_total, d = inputs.shape
-        if isinstance(masking, FullMasking):
-            mod = masking.modulus
-            mkey, skey = jax.random.split(key)
-            masks = modular.uniform_mod(mkey, (P_total, d), mod)
-            masked = modular.modadd(inputs, masks, mod)
-            mask_total = modular.modsum(masks, mod, axis=0)
-        else:
-            skey = key
-            masked = modular.canon(inputs, p)  # kernels need residues in [0, p)
-            mask_total = None
-        shares = sharing.packed_share(
-            skey, masked, M,
-            prime=p, secret_count=s.secret_count, privacy_threshold=s.privacy_threshold,
-        )                                                   # [P, n, B]
-        combined = modular.modsum(shares, p, axis=0)        # [n, B] clerk combine
-        masked_total = sharing.packed_reconstruct(combined, L, prime=p, dimension=d)
+        x = f.to_residues(inputs)
+        masked, mask_total, skey = _mask_stage(
+            masking, f, x, key, key, pid_base=0, d_block0=0
+        )
+        shares = _share_stage(scheme, f, M_host, masked, skey)  # [P, n, B]
+        combined = f.sum(shares, axis=0)                # [n, B] clerk combine
+        masked_total = _reconstruct_stage(scheme, f, L_host, combined, d)
         if mask_total is None:
-            return masked_total
-        return modular.modsub(masked_total, mask_total, masking.modulus)
+            return f.to_int64(masked_total)
+        return f.to_int64(f.sub(masked_total, mask_total))
 
     return round_fn

@@ -10,6 +10,17 @@ mask -> share -> local combine on device, and fold it into running
 block plus accumulators, independent of P. Per dim-tile, reconstruction
 and unmasking run once at the end.
 
+Two drivers share that structure:
+
+- ``StreamingAggregator`` — single chip.
+- ``StreamedPod`` — the streamed x multi-chip composition (round-1 verdict:
+  neither mode alone reached the 10k x 10M flagship). Blocks are sharded
+  over the SimulatedPod ('p', 'd') mesh and every tile step is
+  COLLECTIVE-FREE: each device folds its local share/mask sums into
+  device-local accumulators, and the psum_scatter clerk transpose +
+  all_gather + reconstruct run ONCE per dim tile at the end — ICI traffic
+  is independent of the participant count.
+
 The reference reaches the same scale by chunking vectors into
 secret_count-sized batches and streaming participations through the server
 one HTTP upload at a time (client/src/crypto/sharing/batched.rs:18-53,
@@ -25,15 +36,28 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..fields import fastfield, modular, numtheory, sharing
+from ..fields.ops import FieldOps
 from ..protocol import (
+    ChaChaMasking,
     FullMasking,
     LinearMaskingScheme,
     NoMasking,
     PackedShamirSharing,
 )
-from .simpod import _check_mask_modulus, _to_residues32
+from .simpod import (
+    _check_collective_headroom,
+    _check_mask_modulus,
+    _dim_grain,
+    _build_matrices,
+    _mask_stage,
+    _reconstruct_stage,
+    _scheme_modulus,
+    _share_stage,
+    _to_residues32,
+)
 
 #: get_block(p0, p1, d0, d1) -> integer array [p1-p0, d1-d0]
 BlockProvider = Callable[[int, int, int, int], np.ndarray]
@@ -64,12 +88,16 @@ def synthetic_block_provider(
         z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
         return z ^ (z >> np.uint64(31))
 
+    # uint32 blocks when values fit: half the host->device bytes, and the
+    # device residue pass skips emulated 64-bit ops (_to_residues32)
+    out_dtype = np.uint32 if int(bound) <= (1 << 32) else np.int64
+
     def get_block(p0, p1, d0, d1):
         with np.errstate(over="ignore"):
             rows = _mix(np.arange(p0, p1, dtype=np.uint64)[:, None] + s)
             cols = _mix(np.arange(d0, d1, dtype=np.uint64)[None, :] ^ s)
             vals = _mix(rows ^ cols)
-        return (vals % bound).astype(np.int64)
+        return (vals % bound).astype(out_dtype)
 
     return get_block
 
@@ -228,6 +256,182 @@ class StreamingAggregator:
             if final is None:
                 final = self._finals[d_size] = self._final_fn(d_size)
             out[d0:d1] = np.asarray(final(acc_shares, acc_mask))
+        return out
+
+    def aggregate(self, inputs, key=None) -> np.ndarray:
+        inputs = np.asarray(inputs)
+        return self.aggregate_blocks(
+            array_block_provider(inputs), inputs.shape[0], inputs.shape[1], key
+        )
+
+
+class StreamedPod:
+    """Streamed rounds over a SimulatedPod mesh — the flagship-scale mode.
+
+    Host loop tiles (participants x dim); each tile step is a collective-
+    free SPMD program folding device-local [n, B_loc] share and [d_loc]
+    mask accumulators; one psum_scatter + all_gather + reconstruct runs per
+    dim tile at the end. Covers the full scheme lattice (additive/packed x
+    none/full/chacha) via the simpod stage helpers. Peak device memory is
+    one block shard plus accumulators — independent of total participants.
+    """
+
+    def __init__(
+        self,
+        sharing_scheme,
+        masking_scheme: Optional[LinearMaskingScheme] = None,
+        mesh: Optional[Mesh] = None,
+        participants_chunk: int = 64,
+        dim_chunk: int = 3 * (1 << 20),
+    ):
+        from .simpod import SimulatedPod, default_mesh_shape, make_mesh
+
+        self.scheme = s = sharing_scheme
+        self.modulus = _scheme_modulus(s)
+        self.masking = masking_scheme or NoMasking()
+        if not isinstance(self.masking, (NoMasking, FullMasking, ChaChaMasking)):
+            raise ValueError(
+                f"unsupported masking scheme {type(self.masking).__name__}"
+            )
+        _check_mask_modulus(self.masking, s)
+        if mesh is None:
+            p_shards, d_shards = default_mesh_shape(
+                len(jax.devices()), s.output_size
+            )
+            mesh = make_mesh(p_shards, d_shards)
+        self.mesh = mesh
+        p_shards, d_shards = mesh.devices.shape
+        if s.output_size % p_shards:
+            raise ValueError(
+                f"committee size {s.output_size} must be divisible by the "
+                f"p axis ({p_shards})"
+            )
+        grain = _dim_grain(s, self.masking) * d_shards
+        self._grain = grain
+        # round the tile sizes up to the mesh grain
+        self.participants_chunk = -(-int(participants_chunk) // p_shards) * p_shards
+        self.dim_chunk = -(-int(dim_chunk) // grain) * grain
+        self._M_host, self._L_host = _build_matrices(s)
+        self._field = FieldOps.create(self.modulus, cross_terms=p_shards)
+        _check_collective_headroom(self._field, p_shards)
+        self._steps = {}      # local block shape -> jitted accumulate step
+        self._finals = {}     # dim-tile size -> jitted collective finale
+
+    # -- jitted pieces ---------------------------------------------------
+    def _acc_shapes(self, d_size: int):
+        p_shards, _ = self.mesh.devices.shape
+        n = self.scheme.output_size
+        B = d_size // self.scheme.input_size
+        return (p_shards * n, B), (p_shards, d_size)
+
+    def _new_accs(self, d_size: int):
+        sharding = NamedSharding(self.mesh, P("p", "d"))
+        (sS, sM) = self._acc_shapes(d_size)
+        dt = self._field.dtype
+        return (
+            jax.device_put(jnp.zeros(sS, dt), sharding),
+            jax.device_put(jnp.zeros(sM, dt), sharding),
+        )
+
+    def _step_fn(self, block_shape):
+        f, s, masking = self._field, self.scheme, self.masking
+
+        def local_step(block, tile_key, round_key, tile_base, d_block_base,
+                       acc_shares, acc_mask):
+            # block [Pc_loc, d_loc]; acc_shares [n, B_loc]; acc_mask [1, d_loc]
+            pi = jax.lax.axis_index("p")
+            di = jax.lax.axis_index("d")
+            Pc_loc, d_loc = block.shape
+            dev_key = jax.random.fold_in(jax.random.fold_in(tile_key, pi), di)
+            x = f.to_residues(block)
+            masked, local_mask_sum, skey = _mask_stage(
+                masking, f, x, dev_key, round_key,
+                pid_base=tile_base + pi * Pc_loc,
+                d_block0=d_block_base + di * (d_loc // 8),
+            )
+            shares = _share_stage(s, f, self._M_host, masked, skey)
+            acc_shares = f.add(acc_shares, f.sum(shares, axis=0))
+            if local_mask_sum is not None:
+                acc_mask = f.add(acc_mask, local_mask_sum[None, :])
+            return acc_shares, acc_mask
+
+        fn = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(P("p", "d"), P(), P(), P(), P(), P("p", "d"), P("p", "d")),
+            out_specs=(P("p", "d"), P("p", "d")),
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=(5, 6))
+
+    def _final_fn(self, d_size: int):
+        f, s = self._field, self.scheme
+        masked = not isinstance(self.masking, NoMasking)
+
+        def local_final(acc_shares, acc_mask):
+            d_loc = acc_mask.shape[-1]
+            clerk_rows = jax.lax.psum_scatter(
+                acc_shares, "p", scatter_dimension=0, tiled=True
+            )
+            clerk_rows = f.canon(clerk_rows)
+            gathered = jax.lax.all_gather(clerk_rows, "p", axis=0, tiled=True)
+            masked_total = _reconstruct_stage(
+                s, f, self._L_host, gathered, d_loc
+            )
+            if not masked:
+                return f.to_int64(masked_total)
+            mask_total = f.canon(jax.lax.psum(acc_mask[0], "p"))
+            return f.to_int64(f.sub(masked_total, mask_total))
+
+        fn = jax.shard_map(
+            local_final,
+            mesh=self.mesh,
+            in_specs=(P("p", "d"), P("p", "d")),
+            out_specs=P("d"),
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=(0, 1))
+
+    # -- driver ----------------------------------------------------------
+    def aggregate_blocks(
+        self, get_block: BlockProvider, participants: int, dimension: int, key=None
+    ) -> np.ndarray:
+        """Stream all blocks; returns the [dimension] aggregate (host array)."""
+        if key is None:
+            from ..crypto.core import fresh_prng_key
+
+            key = fresh_prng_key()
+        p_shards, _ = self.mesh.devices.shape
+        pc, dc = self.participants_chunk, self.dim_chunk
+        sharding = NamedSharding(self.mesh, P("p", "d"))
+        out = np.empty(dimension, dtype=np.int64)
+        for di_ix, d0 in enumerate(range(0, dimension, dc)):
+            d1 = min(d0 + dc, dimension)
+            d_size = -(-(d1 - d0) // self._grain) * self._grain  # pad to grain
+            acc_shares, acc_mask = self._new_accs(d_size)
+            for pi_ix, p0 in enumerate(range(0, participants, pc)):
+                p1 = min(p0 + pc, participants)
+                host = np.asarray(get_block(p0, p1, d0, d1))
+                if host.shape != (pc, d_size):  # zero-pad the edge tiles
+                    padded = np.zeros((pc, d_size), dtype=host.dtype)
+                    padded[: host.shape[0], : host.shape[1]] = host
+                    host = padded
+                block = jax.device_put(jnp.asarray(host), sharding)
+                tile_key = jax.random.fold_in(
+                    jax.random.fold_in(key, pi_ix), di_ix
+                )
+                step = self._steps.get(host.shape)
+                if step is None:
+                    step = self._steps[host.shape] = self._step_fn(host.shape)
+                acc_shares, acc_mask = step(
+                    block, tile_key, key,
+                    jnp.int32(p0), jnp.int32(d0 // 8),
+                    acc_shares, acc_mask,
+                )
+            final = self._finals.get(d_size)
+            if final is None:
+                final = self._finals[d_size] = self._final_fn(d_size)
+            out[d0:d1] = np.asarray(final(acc_shares, acc_mask))[: d1 - d0]
         return out
 
     def aggregate(self, inputs, key=None) -> np.ndarray:

@@ -1,0 +1,108 @@
+"""Programmatic JAX backend selection for benchmarks and drivers.
+
+This image's sitecustomize registers the axon TPU PJRT plugin in every
+interpreter and sets ``jax_platforms`` itself, so ``JAX_PLATFORMS`` env-var
+selection is ignored; worse, the axon backend can hang indefinitely at
+init when the chip tunnel is down (round-1 postmortem: both driver
+artifacts died this way). Rules that keep harnesses alive:
+
+- never initialize the TPU backend in-process without first probing it in
+  a KILLABLE subprocess with a bounded timeout;
+- select the backend with ``jax.config.update("jax_platforms", ...)``
+  BEFORE any jax operation, not with env vars;
+- to change platform after a backend initialized, clear backends first.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_PROBE_CODE = """
+import jax
+jax.config.update("jax_platforms", "axon")
+ds = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.float32)
+(x @ x).block_until_ready()
+print("PROBE_OK", ds[0].platform, getattr(ds[0], "device_kind", "?"), flush=True)
+"""
+
+
+def log(msg: str) -> None:
+    print(f"[backend] {msg}", file=sys.stderr, flush=True)
+
+
+def probe_tpu(timeout_s: float, attempts: int = 2) -> bool:
+    """Bounded-time TPU liveness check in a subprocess (init can hang)."""
+    for attempt in range(1, attempts + 1):
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            log(f"TPU probe attempt {attempt}: timed out after {timeout_s:.0f}s")
+            continue
+        dt = time.perf_counter() - t0
+        if r.returncode == 0 and "PROBE_OK" in r.stdout:
+            log(f"TPU probe attempt {attempt}: OK in {dt:.1f}s "
+                f"({r.stdout.strip()})")
+            return True
+        tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
+        log(f"TPU probe attempt {attempt}: rc={r.returncode} in {dt:.1f}s; "
+            + " | ".join(tail))
+    return False
+
+
+def select_platform(env_var: str = "SDA_BENCH_PLATFORM") -> str:
+    """'axon' if the TPU answers a probe (or is forced), else 'cpu'."""
+    want = os.environ.get(env_var, "auto")
+    if want in ("tpu", "axon"):
+        return "axon"
+    if want == "cpu":
+        return "cpu"
+    timeout_s = float(os.environ.get("SDA_BENCH_TPU_PROBE_TIMEOUT", 300))
+    return "axon" if probe_tpu(timeout_s) else "cpu"
+
+
+def use_platform(platform: str) -> None:
+    """Point jax at ``platform``, clearing stale backends if needed."""
+    import jax
+    from jax._src import xla_bridge as xb
+
+    if xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    jax.config.update("jax_platforms", platform)
+
+
+def force_cpu(n_devices: int = 1) -> None:
+    """CPU backend with >= n_devices virtual devices, for mesh tests."""
+    import re
+
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    use_platform("cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except (AttributeError, RuntimeError):
+        pass
+    got = jax.local_device_count()
+    if got < n_devices:
+        raise RuntimeError(
+            f"CPU backend came up with {got} devices, need {n_devices} "
+            f"(XLA_FLAGS={os.environ.get('XLA_FLAGS')!r})"
+        )

@@ -25,10 +25,18 @@ from sda_tpu.server import (
     new_sqlite_server,
 )
 
-from util import new_agent, new_full_agent, new_key_for_agent
+from util import (
+    mongo_real_params,
+    new_agent,
+    new_full_agent,
+    new_key_for_agent,
+    new_mongo_real_service,
+)
 
 
-@pytest.fixture(params=["memory", "jsonfs", "sqlite", "mongo"])
+@pytest.fixture(
+    params=["memory", "jsonfs", "sqlite", "mongo"] + mongo_real_params()
+)
 def service(request, tmp_path):
     if request.param == "memory":
         return new_memory_server()
@@ -39,6 +47,8 @@ def service(request, tmp_path):
         from sda_tpu.server import new_mongo_server
 
         return new_mongo_server(FakeDatabase())
+    if request.param == "mongo-real":
+        return new_mongo_real_service(request)
     return new_jsonfs_server(tmp_path)
 
 

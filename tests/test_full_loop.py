@@ -106,7 +106,13 @@ def check_full_aggregation(aggregation: Aggregation, service):
     np.testing.assert_array_equal(output.positive().values, [2, 4, 6, 8])
 
 
-@pytest.fixture(params=["memory", "jsonfs", "sqlite", "mongo", "http"])
+import util as _util
+
+
+@pytest.fixture(
+    params=["memory", "jsonfs", "sqlite", "mongo", "http"]
+    + _util.mongo_real_params()
+)
 def service(request, tmp_path):
     if request.param == "memory":
         yield new_memory_server()
@@ -115,6 +121,8 @@ def service(request, tmp_path):
         from sda_tpu.server import new_mongo_server
 
         yield new_mongo_server(FakeDatabase())
+    elif request.param == "mongo-real":
+        yield _util.new_mongo_real_service(request)
     elif request.param == "sqlite":
         yield new_sqlite_server(tmp_path / "sda.db")
     elif request.param == "jsonfs":

@@ -96,6 +96,40 @@ def test_full_round_populates_all_protocol_phases():
     assert report["clerk.combine"]["count"] == 3      # one per committee clerk
 
 
+def test_http_request_status_logging_and_counters():
+    """Per-request status lines + status counters (reference analog:
+    server-http/src/lib.rs:105-122 logs method/path/status per request)."""
+    import io
+    import urllib.request
+
+    from sda_tpu.http.server import SdaHttpServer
+    from sda_tpu.server import new_memory_server
+
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    http_log = logging.getLogger("sda_tpu.http.server")
+    http_log.addHandler(handler)
+    old_level = http_log.level
+    http_log.setLevel(logging.INFO)
+    srv = SdaHttpServer(new_memory_server(), bind="127.0.0.1:0").start_background()
+    try:
+        urllib.request.urlopen(srv.address + "/v1/ping").read()
+        try:
+            urllib.request.urlopen(srv.address + "/v1/nonexistent")
+        except urllib.error.HTTPError:
+            pass
+        counts = srv.status_counts
+        assert counts.get(200) == 1
+        assert counts.get(401) == 1  # unknown route without auth -> 401
+        lines = buf.getvalue().strip().splitlines()
+        assert any("GET /v1/ping -> 200" in l for l in lines)
+        assert any("-> 401" in l for l in lines)
+    finally:
+        srv.shutdown()
+        http_log.removeHandler(handler)
+        http_log.setLevel(old_level)
+
+
 def test_configure_logging_levels():
     configure_logging(0)
     assert logging.getLogger().level == logging.WARNING

@@ -26,13 +26,16 @@ from sda_tpu.protocol import (
 )
 from sda_tpu.server import new_jsonfs_server, new_memory_server, new_sqlite_server
 
+import util
 from util import mock_encryption, new_agent, new_full_agent
 
 N_PARTICIPANTS = 100
 N_CLERKS = 3
 
 
-@pytest.fixture(params=["memory", "jsonfs", "sqlite", "mongo"])
+@pytest.fixture(
+    params=["memory", "jsonfs", "sqlite", "mongo"] + util.mongo_real_params()
+)
 def service(request, tmp_path):
     if request.param == "memory":
         return new_memory_server()
@@ -43,6 +46,8 @@ def service(request, tmp_path):
         from sda_tpu.server import new_mongo_server
 
         return new_mongo_server(FakeDatabase())
+    if request.param == "mongo-real":
+        return util.new_mongo_real_service(request)
     return new_jsonfs_server(tmp_path)
 
 

@@ -53,3 +53,65 @@ def mock_encryption(data: bytes) -> Encryption:
     """Raw bytes posing as a ciphertext — server logic never opens them
     (reference mock pattern: integration-tests/tests/service.rs:29-47)."""
     return Encryption("Sodium", Binary(data))
+
+
+# ---------------------------------------------------------------------------
+# Real-MongoDB seam (reference: integration-tests/src/lib.rs:110-140 runs the
+# same suites against a live mongod with a random per-test database, dropped
+# after). Enabled by SDA_TEST_MONGO_URI; in-image runs use the fake instead.
+
+def mongo_real_params():
+    """Extra fixture params when a live mongod is configured."""
+    import os
+
+    return ["mongo-real"] if os.environ.get("SDA_TEST_MONGO_URI") else []
+
+
+def new_mongo_real_service(request):
+    """SdaServerService on a fresh random database of the configured
+    mongod; registers a finalizer that drops the database."""
+    import os
+    import uuid
+
+    import pytest
+
+    from sda_tpu.server import mongo as mongo_mod
+    from sda_tpu.server import new_mongo_server
+
+    uri = os.environ.get("SDA_TEST_MONGO_URI")
+    if not mongo_mod.available():
+        pytest.skip("SDA_TEST_MONGO_URI set but pymongo is not installed")
+    import pymongo
+
+    client = pymongo.MongoClient(uri, serverSelectionTimeoutMS=5000)
+    dbname = "sda_test_" + uuid.uuid4().hex[:12]
+
+    def drop():
+        client.drop_database(dbname)
+        client.close()
+
+    request.addfinalizer(drop)
+    return new_mongo_server(client[dbname])
+
+
+def scheme_lattice_config(name, dim, *, additive_share_count=8):
+    """masking x sharing point of the golden scheme lattice (reference
+    pluggability: masking/mod.rs:33-94 x sharing/mod.rs:35-96), mod 433."""
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        ChaChaMasking,
+        FullMasking,
+        PackedShamirSharing,
+    )
+
+    sharing = (
+        AdditiveSharing(share_count=additive_share_count, modulus=433)
+        if name.startswith("add")
+        else PackedShamirSharing(3, 8, 4, 433, 354, 150)
+    )
+    masking = {
+        "none": None,
+        "full": FullMasking(433),
+        "chacha": ChaChaMasking(433, dim, 128),
+    }[name.split("-")[1]]
+    return sharing, masking
