@@ -229,14 +229,16 @@ def modmatmul32_limbs(mh, ml, v, sp: SolinasPrime):
     chunk = max(1, min(fans.values()))
 
     def stream(a_limbs, b_limbs):
-        # a: [n, k]; b: [..., k, B] -> sum over k of a*b, folded per chunk
+        # a: [n, k]; b: [..., k, B] -> sum over k of a*b, folded per chunk.
+        # Accumulated with explicit adds, not jnp.sum: Mosaic cannot lower
+        # unsigned reductions, and k is tiny so the unrolled adds fuse the
+        # same either way.
         acc = None
         for start in range(0, k, chunk):
-            a_c = a_limbs[:, start : start + chunk]          # [n, kc]
-            b_c = b_limbs[..., start : start + chunk, :]     # [..., kc, B]
-            part = jnp.sum(
-                a_c[:, :, None] * b_c[..., None, :, :], axis=-2, dtype=_U32
-            )                                                # [..., n, B]
+            part = None
+            for j in range(start, min(start + chunk, k)):
+                term = a_limbs[:, j][:, None] * b_limbs[..., j, :][..., None, :]
+                part = term if part is None else part + term  # [..., n, B]
             part = canon32(part, sp)
             acc = part if acc is None else modadd32(acc, part, sp)
         return acc                                           # canonical < p

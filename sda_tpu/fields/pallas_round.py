@@ -119,7 +119,7 @@ def fused_mask_share_combine(
         shares_ref[...] = jnp.zeros_like(shares_ref)
         masktot_ref[...] = jnp.zeros_like(masktot_ref)
 
-        def body(p_ix, _):
+        def body(p_ix, carry):
             x_p = canon32(x_ref[p_ix], sp)                        # [k, TB]
             if masked:
                 mask = draw((k, tile), 0, p_ix)                   # [k, TB]
@@ -136,9 +136,11 @@ def fused_mask_share_combine(
                 mh_ref[...], ml_ref[...], values, sp
             )                                                     # [n, TB]
             shares_ref[...] = modadd32(shares_ref[...], contrib, sp)
-            return 0
+            return carry  # int32 zero: Mosaic cannot legalize an i64 carry
 
-        jax.lax.fori_loop(0, P, body, 0)
+        # int32 bounds AND carry: under x64, Python-int bounds make the loop
+        # index i64, which Mosaic cannot legalize
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(P), body, jnp.int32(0))
 
     # host-side limb split of the active share-matrix columns (minus the
     # fixed zero column 0); tiny [n, m2-1] blocks, same in every grid step
@@ -169,14 +171,20 @@ def fused_mask_share_combine(
         jax.ShapeDtypeStruct((n, B), _U32),
         jax.ShapeDtypeStruct((k, B), _U32),
     ]
-    return pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
-    )(*args)
+    )
+    # trace the kernel with x64 OFF: under the framework's global x64 the
+    # BlockSpec index maps and loop indices become i64, which Mosaic cannot
+    # legalize (func.return (i64) lowering error on real TPU); every value
+    # in the kernel is explicitly uint32/int32 so semantics are unchanged
+    with jax.enable_x64(False):
+        return call(*args)
 
 
 def single_chip_round_pallas(

@@ -1,0 +1,52 @@
+"""Real-TPU smoke tests (env-gated; the suite itself is CPU-hermetic).
+
+Run with ``SDA_TEST_TPU=1 pytest tests/test_tpu_hardware.py`` on a machine
+with a live chip. Each test runs in a subprocess because the conftest pins
+this interpreter to the virtual-CPU mesh and backends cannot be swapped
+reliably mid-suite; the subprocess selects the TPU programmatically
+(utils/backend.py) and asserts exactness on hardware — the one thing the
+interpret-mode Pallas tests (test_pallas_round.py) cannot cover.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("SDA_TEST_TPU") != "1",
+    reason="real-TPU smoke tests need SDA_TEST_TPU=1 and a live chip",
+)
+
+_CHECK = """
+import numpy as np
+from sda_tpu.utils.backend import use_platform
+use_platform("axon")
+import jax, jax.numpy as jnp
+from sda_tpu.fields import numtheory
+from sda_tpu.fields.pallas_round import single_chip_round_pallas
+from sda_tpu.mesh import single_chip_round
+from sda_tpu.protocol import FullMasking, PackedShamirSharing
+
+t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+scheme = PackedShamirSharing(3, 8, t, p, w2, w3)
+rng = np.random.default_rng(7)
+inputs = jnp.asarray(rng.integers(0, 1 << 20, size=(24, 6144), dtype=np.uint32))
+key = jax.random.PRNGKey(5)
+expected = np.asarray(inputs).sum(axis=0) % p
+for build in (single_chip_round, single_chip_round_pallas):
+    fn = jax.jit(build(scheme, FullMasking(p)))
+    out = jax.device_get(fn(inputs, key))
+    assert np.array_equal(out, expected), f"{build.__name__} wrong on TPU"
+print("TPU_EXACT_OK")
+"""
+
+
+def test_rounds_exact_on_hardware():
+    r = subprocess.run(
+        [sys.executable, "-c", _CHECK], capture_output=True, text=True,
+        timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "TPU_EXACT_OK" in r.stdout
