@@ -29,6 +29,7 @@ real hardware.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -71,6 +72,26 @@ def _share_rows_const(values_rows, m_host_row, sp: SolinasPrime):
     return acc
 
 
+def _participant_tile(pb: int, rows_per_participant: int, tile: int) -> int:
+    """Participants per VMEM block, sized so the (double-buffered) input
+    blocks stay ~3MB total. ``rows_per_participant`` counts every uint32
+    row the grid streams per participant: k for the x block, plus 2*draws
+    bit rows in external-bits mode (which therefore tiles more finely)."""
+    cap = max(1, 3_000_000 // (rows_per_participant * tile * 4))
+    return max(pb, (cap // pb) * pb)
+
+
+def _balanced_tiling(P: int, pb: int, tile_cap: int):
+    """(p_tile, P_eff): spread P over equal tiles instead of padding to a
+    whole multiple of tile_cap (P=113 at cap 112 pads to 128, not 224)."""
+    if P <= tile_cap:
+        p_tile = -(-P // pb) * pb
+        return p_tile, p_tile
+    ntiles = -(-P // tile_cap)
+    p_tile = -(-P // (ntiles * pb)) * pb
+    return p_tile, ntiles * p_tile
+
+
 def fused_mask_share_combine(
     x_cols,
     seed,
@@ -81,6 +102,8 @@ def fused_mask_share_combine(
     tile: int = 512,
     external_bits=None,
     interpret: bool = False,
+    p_block: int = 16,
+    p_tile: Optional[int] = None,
 ):
     """[P, k, B] canonical uint32 columns -> ([n, B] combined shares,
     [k, B] mask totals).
@@ -88,6 +111,13 @@ def fused_mask_share_combine(
     external_bits: optional [P, 2*(k+t) or 2*t, B] uint32 pre-drawn bits
     (2 words per drawn residue; mask rows first when masked) — used for
     interpret-mode tests and injectable PRG streams.
+
+    ``p_block`` participants fold per loop step (fewer, larger PRNG draws
+    and one matmul per block); it shrinks to a divisor of P when needed.
+    ``p_tile`` (a multiple of the effective p_block dividing P; derived
+    from the VMEM budget when None) sets how many participants each
+    grid-axis-1 block streams through VMEM. The mod-p algebra is exact,
+    so neither size ever changes results.
     """
     P, k, B = x_cols.shape
     n, m2 = m_host.shape
@@ -96,8 +126,25 @@ def fused_mask_share_combine(
         raise ValueError(f"share matrix width {m2} != 1+k+t={1 + k + t}")
     if B % tile:
         raise ValueError(f"B={B} must be divisible by tile={tile}")
+    pb = max(1, min(int(p_block), P))
+    if P % pb:  # keep the accept-any-P contract: shrink to a divisor
+        pb = math.gcd(pb, P)
     draws = (k + t) if masked else t
     internal = external_bits is None
+    # participants stream through VMEM in tiles of p_tile along a second
+    # (reduction) grid axis — holding all P in one block OOMs VMEM beyond
+    # a few hundred participants (external-bits mode carries 2*draws extra
+    # rows per participant and tiles more finely)
+    rows = k if internal else k + 2 * draws
+    if p_tile is None:
+        p_tile = min(P, _participant_tile(pb, rows, tile))
+        p_tile = math.gcd(p_tile, P) if P % p_tile else p_tile
+    p_tile = int(p_tile)
+    if P % p_tile or p_tile % pb:
+        raise ValueError(
+            f"p_tile={p_tile} must divide P={P} and be a multiple of "
+            f"p_block={pb}"
+        )
 
     def kernel(*refs):
         if internal:
@@ -105,42 +152,96 @@ def fused_mask_share_combine(
         else:
             seed_ref, x_ref, mh_ref, ml_ref, bits_ref, shares_ref, masktot_ref = refs
         if internal:
-            pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+            # one distinct stream per (dim tile, participant tile); Mosaic
+            # caps prng_seed at 2 values, so flatten the grid coordinates
+            pltpu.prng_seed(
+                seed_ref[0],
+                pl.program_id(0) * jnp.int32(P // p_tile) + pl.program_id(1),
+            )
 
-        def draw(shape, row0, p_ix):
+        # raw uint32 partial sums stay exact for `fan` canonical residues
+        fan = max(1, 0xFFFFFFFF // (sp.p - 1))
+
+        def fold_slices(get, count):
+            """Σ of ``get(i)`` (canonical [r, TB]) for i < count: raw adds,
+            canonicalizing every ``fan`` terms."""
+            acc, partial, cnt = None, None, 0
+            for i in range(count):
+                sl = get(i)
+                partial = sl if partial is None else partial + sl
+                cnt += 1
+                if cnt == fan or i == count - 1:
+                    pc = canon32(partial, sp)
+                    acc = pc if acc is None else modadd32(acc, pc, sp)
+                    partial, cnt = None, 0
+            return acc
+
+        def draw_sum(rows, row0, p0):
+            """Σ over the pb participants of [rows, TB] uniform residues."""
             if internal:
-                hi = pltpu.bitcast(pltpu.prng_random_bits(shape), _U32)
-                lo = pltpu.bitcast(pltpu.prng_random_bits(shape), _U32)
-            else:
-                hi = bits_ref[p_ix, 2 * row0 : 2 * row0 + shape[0], :]
-                lo = bits_ref[p_ix, 2 * row0 + shape[0] : 2 * (row0 + shape[0]), :]
-            return _uniform_from_bits(hi, lo, sp)
+                bits = pltpu.bitcast(
+                    pltpu.prng_random_bits((2 * pb * rows, tile)), _U32
+                )
+                hi = bits[: pb * rows, :]
+                lo = bits[pb * rows :, :]
+                res = _uniform_from_bits(hi, lo, sp)          # [pb*rows, TB]
+                return fold_slices(
+                    lambda i: res[i * rows : (i + 1) * rows, :], pb
+                )
+            blk = bits_ref[pl.ds(p0, pb)]                     # [pb, 2*draws, TB]
+            hi = blk[:, 2 * row0 : 2 * row0 + rows, :]
+            lo = blk[:, 2 * row0 + rows : 2 * (row0 + rows), :]
+            res = _uniform_from_bits(hi, lo, sp)              # [pb, rows, TB]
+            return fold_slices(lambda i: res[i], pb)
 
-        shares_ref[...] = jnp.zeros_like(shares_ref)
-        masktot_ref[...] = jnp.zeros_like(masktot_ref)
+        # matrix limb columns: first k drive the (masked) secrets, last t
+        # the share randomness
+        mh_k, mh_t = mh_ref[...][:, :k], mh_ref[...][:, k:]
+        ml_k, ml_t = ml_ref[...][:, :k], ml_ref[...][:, k:]
 
-        def body(p_ix, carry):
-            x_p = canon32(x_ref[p_ix], sp)                        # [k, TB]
+        # the participant axis (grid dim 1) revisits the same output block:
+        # zero it on the first visit, accumulate on the rest
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            shares_ref[...] = jnp.zeros_like(shares_ref)
+            masktot_ref[...] = jnp.zeros_like(masktot_ref)
+
+        def body(b_ix, carry):
+            # share-combine is LINEAR: the clerk-combined output
+            # Σ_p M @ values_p equals M @ (Σ_p values_p), so participants
+            # fold with cheap adds FIRST and the matmul runs once per fold
+            # block — per-participant share rows are never materialized
+            # (in the distributed protocol they live on the participants'
+            # own devices; a chip computing the aggregate needs only their
+            # sum). Bit-exact vs the per-participant XLA path given the
+            # same bits: mod-p arithmetic is exact, so fold order is free.
+            p0 = b_ix * np.int32(pb)
+            x_blk = x_ref[pl.ds(p0, pb)]                      # [pb, k, TB]
+            # canon at first touch: fold_slices' raw-add fan bound needs
+            # terms < p, and the docstring contract (canonical inputs) is
+            # otherwise unenforced
+            xsum = fold_slices(lambda i: canon32(x_blk[i], sp), pb)  # [k, TB]
             if masked:
-                mask = draw((k, tile), 0, p_ix)                   # [k, TB]
-                values_k = modadd32(x_p, mask, sp)
-                masktot_ref[...] = modadd32(masktot_ref[...], mask, sp)
-                rand = draw((t, tile), k, p_ix)
+                masksum = draw_sum(k, 0, p0)                  # [k, TB]
+                values_k = modadd32(xsum, masksum, sp)
+                masktot_ref[...] = modadd32(masktot_ref[...], masksum, sp)
+                randsum = draw_sum(t, k, p0)
             else:
-                values_k = x_p
-                rand = draw((t, tile), 0, p_ix)
-            values = jnp.concatenate([values_k, rand], axis=0)    # [k+t, TB]
-            # full-block limb-stream matmul: all n share rows at once, all
-            # 8 sublanes live (vs the old per-row [1, TB] const-mul loop)
-            contrib = fastfield.modmatmul32_limbs(
-                mh_ref[...], ml_ref[...], values, sp
-            )                                                     # [n, TB]
+                values_k = xsum
+                randsum = draw_sum(t, 0, p0)
+            contrib = modadd32(
+                fastfield.modmatmul32_limbs(mh_k, ml_k, values_k, sp),
+                fastfield.modmatmul32_limbs(mh_t, ml_t, randsum, sp),
+                sp,
+            )                                                 # [n, TB]
             shares_ref[...] = modadd32(shares_ref[...], contrib, sp)
             return carry  # int32 zero: Mosaic cannot legalize an i64 carry
 
         # int32 bounds AND carry: under x64, Python-int bounds make the loop
         # index i64, which Mosaic cannot legalize
-        jax.lax.fori_loop(jnp.int32(0), jnp.int32(P), body, jnp.int32(0))
+        jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(p_tile // pb), body, jnp.int32(0)
+        )
 
     # host-side limb split of the active share-matrix columns (minus the
     # fixed zero column 0); tiny [n, m2-1] blocks, same in every grid step
@@ -148,24 +249,27 @@ def fused_mask_share_combine(
     mh_np = (m_active >> 15).astype(np.uint32)
     ml_np = (m_active & 0x7FFF).astype(np.uint32)
 
-    grid = (B // tile,)
+    # grid dim 0: dim tiles; grid dim 1 (innermost): participant tiles
+    # streamed through the same output block
+    grid = (B // tile, P // p_tile)
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),                     # seed
-        pl.BlockSpec((P, k, tile), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
-        pl.BlockSpec(mh_np.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec(ml_np.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((p_tile, k, tile), lambda i, j: (j, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(mh_np.shape, lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec(ml_np.shape, lambda i, j: (0, 0), memory_space=pltpu.VMEM),
     ]
     args = [jnp.asarray([seed], jnp.int32), x_cols,
             jnp.asarray(mh_np), jnp.asarray(ml_np)]
     if not internal:
         in_specs.append(
-            pl.BlockSpec((P, 2 * draws, tile), lambda i: (0, 0, i),
+            pl.BlockSpec((p_tile, 2 * draws, tile), lambda i, j: (j, 0, i),
                          memory_space=pltpu.VMEM)
         )
         args.append(external_bits)
     out_specs = [
-        pl.BlockSpec((n, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
-        pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec((n, tile), lambda i, j: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec((k, tile), lambda i, j: (0, i), memory_space=pltpu.VMEM),
     ]
     out_shape = [
         jax.ShapeDtypeStruct((n, B), _U32),
@@ -193,6 +297,8 @@ def single_chip_round_pallas(
     tile: Optional[int] = None,
     interpret: bool = False,
     external_bits_fn=None,
+    p_block: int = 16,
+    p_tile: Optional[int] = None,
 ):
     """Drop-in alternative to mesh.single_chip_round on the fused kernel.
 
@@ -228,12 +334,25 @@ def single_chip_round_pallas(
         P, d = inputs.shape
         x = fastfield.to_residues32(inputs, sp)
         x_cols = batch_columns(x, k)                               # [P, k, B0]
+        pb = max(1, min(p_block, P))
         B0 = x_cols.shape[-1]
         # lane-dim tile: multiples of 128 lanes; large tiles amortize the
         # grid-step overhead, small B avoids padding waste
         TB = tile if tile is not None else (
-            1024 if B0 >= 1024 else max(128, -(-B0 // 128) * 128)
+            2048 if B0 >= 2048 else max(128, -(-B0 // 128) * 128)
         )
+        # pad the participant axis to a balanced tiling (zero rows
+        # aggregate as zero; their masks cancel)
+        rows = k if external_bits_fn is None else k + 2 * draws
+        if p_tile is None:
+            ptile_eff, P_eff = _balanced_tiling(
+                P, pb, _participant_tile(pb, rows, TB)
+            )
+        else:
+            ptile_eff = int(p_tile)
+            P_eff = -(-P // ptile_eff) * ptile_eff
+        if P_eff > P:
+            x_cols = jnp.pad(x_cols, ((0, P_eff - P), (0, 0), (0, 0)))
         pad = (-B0) % TB
         if pad:
             x_cols = jnp.pad(x_cols, ((0, 0), (0, 0), (0, pad)))
@@ -241,10 +360,11 @@ def single_chip_round_pallas(
         seed = jax.random.randint(key, (), 0, np.int32(2**31 - 1), dtype=jnp.int32)
         ext = None
         if external_bits_fn is not None:
-            ext = external_bits_fn(key, P, draws, B)
+            ext = external_bits_fn(key, P_eff, draws, B)
         shares, mask_tot = fused_mask_share_combine(
             x_cols, seed, sp, m_host, t, masked,
-            tile=TB, external_bits=ext, interpret=interpret,
+            tile=TB, external_bits=ext, interpret=interpret, p_block=pb,
+            p_tile=ptile_eff,
         )
         from .sharing import packed_reconstruct32
 

@@ -169,28 +169,41 @@ def _mask_stage(masking, f: FieldOps, x, key, round_key, pid_base, d_block0):
     return masked, f.sum(masks, axis=0), skey
 
 
-def _share_stage(scheme, f: FieldOps, M_host, masked, skey):
-    """[S, d_loc] masked residues -> [S, n, B] per-participant share rows."""
+def _share_sum_stage(scheme, f: FieldOps, M_host, masked, skey):
+    """[S, d_loc] masked residues -> [n, B] participant-SUMMED share rows.
+
+    Share generation is linear in the (secrets, randomness) vector, so the
+    clerk-combined output Σ_p M @ v_p equals M @ Σ_p v_p: participants
+    fold with cheap modular adds FIRST and the share matmul runs once —
+    the [S, n, B] per-participant share tensor is never materialized
+    (those rows live on the participants' own devices in the federated
+    protocol; a pod computing the aggregate needs only their sum).
+    Bit-exact vs summing per-participant shares from
+    ``sharing.packed_share32``/``packed_share``/``additive_share`` (the
+    federated client path): the same randomness shapes are drawn from the
+    same key and mod-m arithmetic is exact, so fold order is free —
+    tests/test_mesh.py and test_fast_rounds.py pin this equivalence.
+    """
+    S, d = masked.shape
     if isinstance(scheme, PackedShamirSharing):
+        k, t = scheme.secret_count, scheme.privacy_threshold
+        B = -(-d // k)
+        rand = f.uniform(skey, (S, t, B))
+        rsum = f.sum(rand, axis=0)                             # [t, B]
+        sk = sharing.batch_columns(f.sum(masked, axis=0), k)   # [k, B]
+        zeros = jnp.zeros((1, B), sk.dtype)
+        values = jnp.concatenate([zeros, sk, rsum], axis=0)    # [m2, B]
         if f.sp is not None:
-            return sharing.packed_share32(
-                skey, masked, M_host, f.sp,
-                secret_count=scheme.secret_count,
-                privacy_threshold=scheme.privacy_threshold,
-            )
-        return sharing.packed_share(
-            skey, masked, jnp.asarray(M_host),
-            prime=scheme.prime_modulus,
-            secret_count=scheme.secret_count,
-            privacy_threshold=scheme.privacy_threshold,
-        )
-    # additive: n-1 uniform draws, last share = masked - sum(draws)
-    # (reference: sharing/additive.rs:32-52); B == d_loc (input_size 1)
-    S, d_loc = masked.shape
+            return fastfield.modmatmul32(M_host, values, f.sp)
+        from ..fields import modular
+
+        return modular.modmatmul(jnp.asarray(M_host), values, f.m)
+    # additive: Σ_p last_p = Σ_p masked_p - Σ over all draws
     n = scheme.share_count
-    draws = f.uniform(skey, (S, n - 1, d_loc))
-    last = f.sub(masked, f.sum(draws, axis=-2))
-    return jnp.concatenate([draws, last[:, None, :]], axis=1)
+    draws = f.uniform(skey, (S, n - 1, d))
+    dsum = f.sum(draws, axis=0)                                # [n-1, d]
+    last = f.sub(f.sum(masked, axis=0), f.sum(dsum, axis=0))   # [d]
+    return jnp.concatenate([dsum, last[None, :]], axis=0)
 
 
 def _scan_combine(f: FieldOps, scheme, masking, M_host, x, key, round_key,
@@ -222,8 +235,7 @@ def _scan_combine(f: FieldOps, scheme, masking, M_host, x, key, round_key,
             masking, f, blk, bkey, round_key,
             pid_base=pid0 + i * chunk, d_block0=dblk0,
         )
-        shares = _share_stage(scheme, f, M_host, masked, skey)
-        acc_s = f.add(acc_s, f.sum(shares, axis=0))
+        acc_s = f.add(acc_s, _share_sum_stage(scheme, f, M_host, masked, skey))
         if mask_sum is not None:
             acc_m = f.add(acc_m, mask_sum)
         return (acc_s, acc_m), None
@@ -451,8 +463,8 @@ def single_chip_round(
         masked, mask_total, skey = _mask_stage(
             masking, f, x, key, key, pid_base=0, d_block0=0
         )
-        shares = _share_stage(scheme, f, M_host, masked, skey)  # [P, n, B]
-        combined = f.sum(shares, axis=0)                # [n, B] clerk combine
+        # share + clerk combine fused via linearity (see _share_sum_stage)
+        combined = _share_sum_stage(scheme, f, M_host, masked, skey)  # [n, B]
         masked_total = _reconstruct_stage(scheme, f, L_host, combined, d)
         if mask_total is None:
             return f.to_int64(masked_total)

@@ -55,7 +55,7 @@ from .simpod import (
     _mask_stage,
     _reconstruct_stage,
     _scheme_modulus,
-    _share_stage,
+    _share_sum_stage,
     _to_residues32,
 )
 
@@ -135,64 +135,29 @@ class StreamingAggregator:
             s.prime_modulus, s.omega_secrets, s.omega_shares,
             tuple(range(s.share_count)),
         )
-        self._sp = fastfield.SolinasPrime.try_from(s.prime_modulus)
+        self._field = FieldOps.create(s.prime_modulus)
+        self._sp = self._field.sp
         self._steps = {}      # block shape -> jitted accumulate step
         self._finals = {}     # dim size -> jitted reconstruct+unmask
 
     # -- jitted pieces ---------------------------------------------------
     def _step_fn(self, block_shape):
-        s, sp, mask = self.scheme, self._sp, isinstance(self.masking, FullMasking)
-        p = s.prime_modulus
+        s, f = self.scheme, self._field
         M_host = self._M_host
 
-        if sp is not None:
-
-            def step(block, key, acc_shares, acc_mask):
-                x = _to_residues32(block, sp)
-                if mask:
-                    mkey, skey = jax.random.split(key)
-                    masks = fastfield.uniform32(mkey, block.shape, sp)
-                    masked = fastfield.modadd32(x, masks, sp)
-                    acc_mask = fastfield.modadd32(
-                        acc_mask, fastfield.modsum32(masks, sp, axis=0), sp
-                    )
-                else:
-                    skey = key
-                    masked = x
-                shares = sharing.packed_share32(
-                    skey, masked, M_host, sp,
-                    secret_count=s.secret_count,
-                    privacy_threshold=s.privacy_threshold,
-                )
-                acc_shares = fastfield.modadd32(
-                    acc_shares, fastfield.modsum32(shares, sp, axis=0), sp
-                )
-                return acc_shares, acc_mask
-
-        else:
-            M = jnp.asarray(M_host)
-
-            def step(block, key, acc_shares, acc_mask):
-                x = modular.canon(block.astype(jnp.int64), p)
-                if mask:
-                    mkey, skey = jax.random.split(key)
-                    masks = modular.uniform_mod(mkey, block.shape, p)
-                    masked = modular.modadd(x, masks, p)
-                    acc_mask = modular.modadd(
-                        acc_mask, modular.modsum(masks, p, axis=0), p
-                    )
-                else:
-                    skey = key
-                    masked = x
-                shares = sharing.packed_share(
-                    skey, masked, M,
-                    prime=p, secret_count=s.secret_count,
-                    privacy_threshold=s.privacy_threshold,
-                )
-                acc_shares = modular.modadd(
-                    acc_shares, modular.modsum(shares, p, axis=0), p
-                )
-                return acc_shares, acc_mask
+        def step(block, key, acc_shares, acc_mask):
+            x = f.to_residues(block)
+            masked, mask_sum, skey = _mask_stage(
+                self.masking, f, x, key, key, pid_base=0, d_block0=0
+            )
+            # share + participant-combine fused via linearity
+            # (simpod._share_sum_stage): no [S, n, B] tensor in HBM
+            acc_shares = f.add(
+                acc_shares, _share_sum_stage(s, f, M_host, masked, skey)
+            )
+            if mask_sum is not None:
+                acc_mask = f.add(acc_mask, mask_sum)
+            return acc_shares, acc_mask
 
         return jax.jit(step, donate_argnums=(2, 3))
 
@@ -349,8 +314,9 @@ class StreamedPod:
                 pid_base=tile_base + pi * Pc_loc,
                 d_block0=d_block_base + di * (d_loc // 8),
             )
-            shares = _share_stage(s, f, self._M_host, masked, skey)
-            acc_shares = f.add(acc_shares, f.sum(shares, axis=0))
+            acc_shares = f.add(
+                acc_shares, _share_sum_stage(s, f, self._M_host, masked, skey)
+            )
             if local_mask_sum is not None:
                 acc_mask = f.add(acc_mask, local_mask_sum[None, :])
             return acc_shares, acc_mask

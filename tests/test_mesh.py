@@ -185,3 +185,53 @@ def test_pod_noncanonical_inputs():
     inputs = jnp.full((4, 6), 1 << 40, dtype=jnp.int64)
     out = np.asarray(fn(inputs, jax.random.PRNGKey(0)))
     np.testing.assert_array_equal(out, np.full(6, (4 * (1 << 40)) % p))
+
+
+def test_share_sum_stage_equals_per_participant_fold():
+    """_share_sum_stage's linearity fusion must be bit-exact vs summing
+    per-participant share rows drawn from the same key (both schemes,
+    both field paths)."""
+    import jax.numpy as jnp
+
+    from sda_tpu.fields import numtheory, sharing
+    from sda_tpu.fields.ops import FieldOps
+    from sda_tpu.mesh.simpod import _build_matrices, _share_sum_stage
+
+    key = jax.random.PRNGKey(17)
+    rng = np.random.default_rng(17)
+
+    for scheme in (
+        GOLDEN,                                    # generic int64 path
+        PackedShamirSharing(                       # uint32 Solinas path
+            3, 8, *numtheory.generate_packed_params(3, 8, 28)[0:1],
+            *numtheory.generate_packed_params(3, 8, 28)[1:],
+        ),
+        AdditiveSharing(share_count=8, modulus=433),
+    ):
+        mod = getattr(scheme, "prime_modulus", getattr(scheme, "modulus", None))
+        f = FieldOps.create(mod)
+        M_host, _ = _build_matrices(scheme)
+        masked = f.to_residues(rng.integers(0, mod, size=(5, 36)))
+        fused = np.asarray(_share_sum_stage(scheme, f, M_host, masked, key))
+        if isinstance(scheme, PackedShamirSharing):
+            if f.sp is not None:
+                per = sharing.packed_share32(
+                    key, masked, M_host, f.sp,
+                    secret_count=scheme.secret_count,
+                    privacy_threshold=scheme.privacy_threshold,
+                )
+            else:
+                per = sharing.packed_share(
+                    key, masked, jnp.asarray(M_host),
+                    prime=scheme.prime_modulus,
+                    secret_count=scheme.secret_count,
+                    privacy_threshold=scheme.privacy_threshold,
+                )
+        else:
+            per = sharing.additive_share(
+                key, masked, share_count=scheme.share_count, modulus=mod
+            )
+        np.testing.assert_array_equal(
+            fused, np.asarray(f.sum(per, axis=0)),
+            err_msg=f"linearity fusion diverged for {type(scheme).__name__}",
+        )

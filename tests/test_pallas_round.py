@@ -79,6 +79,59 @@ def test_pallas_kernel_matches_xla_shares_same_bits():
     np.testing.assert_array_equal(np.asarray(mask_tot), np.asarray(expected_mask_tot))
 
 
+def test_pallas_round_streams_participant_tiles():
+    """P larger than one VMEM participant tile: the kernel's second grid
+    axis must zero-init on the first visit and accumulate across revisits
+    of the same output block (the lenet-60k VMEM-OOM regression: all P in
+    one block). p_tile=32 with P=70 forces ceil(80/32)=3 grid-axis-1
+    steps — the auto tile would fit all of P in one block at these
+    shapes and never exercise the revisit path."""
+    s = fast_scheme()
+    fn = single_chip_round_pallas(
+        s, FullMasking(s.prime_modulus),
+        tile=128, interpret=True, external_bits_fn=external_bits,
+        p_tile=32,
+    )
+    rng = np.random.default_rng(23)
+    inputs = rng.integers(0, 1 << 20, size=(70, 500))
+    out = np.asarray(fn(jnp.asarray(inputs), jax.random.PRNGKey(9)))
+    np.testing.assert_array_equal(out, inputs.sum(axis=0) % s.prime_modulus)
+
+
+def test_pallas_combined_shares_equal_per_participant_sum():
+    """Linearity fusion (Σp M@v_p == M@Σp v_p): kernel combined shares must
+    equal folding per-participant packed_share32 rows from the same bits."""
+    s = fast_scheme()
+    sp = fastfield.SolinasPrime.try_from(s.prime_modulus)
+    k, t = s.secret_count, s.privacy_threshold
+    m_host = numtheory.packed_share_matrix(
+        k, s.share_count, t, s.prime_modulus, s.omega_secrets, s.omega_shares
+    )
+    P, d = 6, 384
+    B = d // k
+    rng = np.random.default_rng(31)
+    x = jnp.asarray(rng.integers(0, s.prime_modulus, size=(P, d)).astype(np.uint32))
+    bits = external_bits(jax.random.PRNGKey(44), P, t, B)  # unmasked: t rows
+
+    shares, _ = fused_mask_share_combine(
+        batch_columns(x, k), 0, sp, m_host, t, False,
+        tile=128, external_bits=bits, interpret=True, p_block=2,
+    )
+    # per-participant path from the identical bits
+    rand = _uniform_from_bits(bits[:, 0:t, :], bits[:, t:2 * t, :], sp)
+    per_part = fastfield.modmatmul32(
+        m_host,
+        jnp.concatenate(
+            [jnp.zeros((P, 1, B), jnp.uint32), batch_columns(x, k), rand],
+            axis=1,
+        ),
+        sp,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(shares), np.asarray(fastfield.modsum32(per_part, sp, axis=0))
+    )
+
+
 def test_pallas_round_rejects_generic_prime():
     s = PackedShamirSharing(3, 8, 4, 433, 354, 150)
     with pytest.raises(ValueError, match="Solinas"):
