@@ -345,8 +345,21 @@ def main():
         print(json.dumps(result), flush=True)
     out_path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCH_SUITE.json")
+    # merge by config name so a partial SDA_BENCH_CONFIGS run refreshes
+    # only what it measured instead of clobbering the other records
+    merged = {}
+    try:
+        with open(out_path) as f:
+            for r in json.load(f).get("results", []):
+                merged[r.get("config")] = r
+    except (OSError, ValueError):
+        pass
+    for r in results:
+        merged[r.get("config")] = r
+    ordered = [merged[n] for n in CONFIGS if n in merged]
+    ordered += [r for c, r in merged.items() if c not in CONFIGS]
     with open(out_path, "w") as f:
-        json.dump({"suite": meta, "results": results}, f, indent=2)
+        json.dump({"suite": meta, "results": ordered}, f, indent=2)
 
 
 if __name__ == "__main__":
