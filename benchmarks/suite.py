@@ -264,7 +264,7 @@ def _streaming_bench(name, participants, dim, max_seconds):
     # chunks serialize like the real stream)
     dev_blocks = [jnp.asarray(prov(i * pc, (i + 1) * pc, 0, dim_covered))
                   for i in range(4)]
-    warm = step(dev_blocks[0], key,
+    warm = step(dev_blocks[0], key, key, jnp.int32(0), jnp.int32(0),
                 jnp.zeros_like(acc_shares), jnp.zeros_like(acc_mask))
     jax.device_get(jnp.ravel(warm[0])[0])
 
@@ -273,7 +273,8 @@ def _streaming_bench(name, participants, dim, max_seconds):
     def dispatch(_):
         bkey = jax.random.fold_in(key, state["pi"])
         state["acc"], state["mask"] = step(
-            dev_blocks[state["pi"] % len(dev_blocks)], bkey,
+            dev_blocks[state["pi"] % len(dev_blocks)], bkey, key,
+            jnp.int32(state["pi"] * pc), jnp.int32(0),
             state["acc"], state["mask"],
         )
         state["pi"] += 1

@@ -28,7 +28,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="committee size (3^a - 1: 2, 8, 26, ...)")
     parser.add_argument("--secrets-per-batch", type=int, default=3)
     parser.add_argument("--modulus-bits", type=int, default=28)
-    parser.add_argument("--mask", choices=["none", "full"], default="full")
+    parser.add_argument("--mask", choices=["none", "full", "chacha"],
+                        default="full")
     parser.add_argument("--streaming", action="store_true",
                         help="chunked single-chip rounds (HBM-exceeding sizes)")
     parser.add_argument("--participants-chunk", type=int, default=64)
@@ -49,14 +50,17 @@ def main(argv=None) -> int:
 
     from ..fields import numtheory
     from ..mesh import SimulatedPod, StreamingAggregator
-    from ..protocol import FullMasking, NoMasking, PackedShamirSharing
+    from ..protocol import ChaChaMasking, FullMasking, NoMasking, PackedShamirSharing
 
     k = args.secrets_per_batch
     t, p, w2, w3 = numtheory.generate_packed_params(k, args.clerks, args.modulus_bits)
     scheme = PackedShamirSharing(k, args.clerks, t, p, w2, w3)
-    masking = FullMasking(p) if args.mask == "full" else NoMasking()
-
-    dim = args.dim - args.dim % k if args.dim % k else args.dim
+    dim = args.dim  # both execution paths auto-pad to the scheme grain
+    masking = {
+        "none": NoMasking(),
+        "full": FullMasking(p),
+        "chacha": ChaChaMasking(p, dim, 128),
+    }[args.mask]
     rng = np.random.default_rng(0)
     inputs = rng.integers(0, 1 << 20, size=(args.participants, dim), dtype=np.int64)
 
@@ -73,16 +77,7 @@ def main(argv=None) -> int:
         elapsed = time.perf_counter() - start
         mode = "streaming"
     else:
-        pod = SimulatedPod(scheme, masking)
-        pad = (-args.participants) % pod.mesh.devices.shape[0]
-        if pad:
-            inputs = np.concatenate(
-                [inputs, np.zeros((pad, dim), dtype=np.int64)], axis=0
-            )
-        d_align = scheme.secret_count * pod.mesh.devices.shape[1]
-        trim = dim - dim % d_align
-        inputs = inputs[:, :trim]
-        dim = trim
+        pod = SimulatedPod(scheme, masking)  # auto-pads to the mesh grain
         out = np.asarray(pod.aggregate(inputs, key=key))  # includes compile
         start = time.perf_counter()
         out = np.asarray(pod.aggregate(inputs, key=key))

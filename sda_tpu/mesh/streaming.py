@@ -103,7 +103,12 @@ def synthetic_block_provider(
 
 
 class StreamingAggregator:
-    """Chunked single-chip rounds: fixed device memory for any P and d."""
+    """Chunked single-chip rounds: fixed device memory for any P and d.
+
+    Full masking-scheme coverage: none/full/chacha — ChaCha seed masks
+    are expanded on device per tile at the tile's (participant, dim)
+    offset, so every tiling of the same round key sees the same masks.
+    """
 
     def __init__(
         self,
@@ -116,16 +121,16 @@ class StreamingAggregator:
             raise ValueError("StreamingAggregator runs Packed-Shamir rounds")
         self.scheme = s = sharing_scheme
         self.masking = masking_scheme or NoMasking()
-        if not isinstance(self.masking, (NoMasking, FullMasking)):
-            raise ValueError("streaming masking: None or Full (seed PRGs are host-side)")
-        _check_mask_modulus(self.masking, s)
-        if dim_chunk % s.secret_count:
+        if not isinstance(self.masking, (NoMasking, FullMasking, ChaChaMasking)):
             raise ValueError(
-                f"dim_chunk {dim_chunk} must be divisible by secret_count "
-                f"{s.secret_count}"
+                f"unsupported masking scheme {type(self.masking).__name__}"
             )
+        _check_mask_modulus(self.masking, s)
+        # ChaCha seed masks expand a window of one per-participant stream at
+        # each tile's dim offset, so tiles align to the 8-word block grain
+        self._grain = _dim_grain(s, self.masking)
         self.participants_chunk = int(participants_chunk)
-        self.dim_chunk = int(dim_chunk)
+        self.dim_chunk = -(-int(dim_chunk) // self._grain) * self._grain
         self._M_host = numtheory.packed_share_matrix(
             s.secret_count, s.share_count, s.privacy_threshold,
             s.prime_modulus, s.omega_secrets, s.omega_shares,
@@ -145,10 +150,14 @@ class StreamingAggregator:
         s, f = self.scheme, self._field
         M_host = self._M_host
 
-        def step(block, key, acc_shares, acc_mask):
+        def step(block, key, round_key, pid0, dblk0, acc_shares, acc_mask):
             x = f.to_residues(block)
+            # pid0/dblk0 (traced) locate this tile in the global stream so
+            # ChaCha seed masks expand the right window of each
+            # participant's stream regardless of tiling
             masked, mask_sum, skey = _mask_stage(
-                self.masking, f, x, key, key, pid_base=0, d_block0=0
+                self.masking, f, x, key, round_key,
+                pid_base=pid0, d_block0=dblk0,
             )
             # share + participant-combine fused via linearity
             # (simpod._share_sum_stage): no [S, n, B] tensor in HBM
@@ -159,12 +168,12 @@ class StreamingAggregator:
                 acc_mask = f.add(acc_mask, mask_sum)
             return acc_shares, acc_mask
 
-        return jax.jit(step, donate_argnums=(2, 3))
+        return jax.jit(step, donate_argnums=(5, 6))
 
     def _final_fn(self, d_size):
         s, sp = self.scheme, self._sp
         p = s.prime_modulus
-        mask = isinstance(self.masking, FullMasking)
+        mask = not isinstance(self.masking, NoMasking)
         L_host = self._L_host
 
         if sp is not None:
@@ -206,21 +215,30 @@ class StreamingAggregator:
         for di, d0 in enumerate(range(0, dimension, self.dim_chunk)):
             d1 = min(d0 + self.dim_chunk, dimension)
             d_size = d1 - d0
-            B = -(-d_size // s.secret_count)
+            ds_pad = -(-d_size // self._grain) * self._grain  # edge tile
+            B = ds_pad // s.secret_count
             acc_shares = jnp.zeros((s.share_count, B), acc_dtype)
-            acc_mask = jnp.zeros((d_size,), acc_dtype)
+            acc_mask = jnp.zeros((ds_pad,), acc_dtype)
             for pi, p0 in enumerate(range(0, participants, self.participants_chunk)):
                 p1 = min(p0 + self.participants_chunk, participants)
-                block = jnp.asarray(np.asarray(get_block(p0, p1, d0, d1)))
+                host = np.asarray(get_block(p0, p1, d0, d1))
+                if ds_pad != d_size:  # zero columns aggregate as zero
+                    padded = np.zeros((host.shape[0], ds_pad), dtype=host.dtype)
+                    padded[:, :d_size] = host
+                    host = padded
+                block = jnp.asarray(host)
                 bkey = jax.random.fold_in(jax.random.fold_in(key, pi), di)
                 step = self._steps.get(block.shape)
                 if step is None:
                     step = self._steps[block.shape] = self._step_fn(block.shape)
-                acc_shares, acc_mask = step(block, bkey, acc_shares, acc_mask)
-            final = self._finals.get(d_size)
+                acc_shares, acc_mask = step(
+                    block, bkey, key, jnp.int32(p0), jnp.int32(d0 // 8),
+                    acc_shares, acc_mask,
+                )
+            final = self._finals.get(ds_pad)
             if final is None:
-                final = self._finals[d_size] = self._final_fn(d_size)
-            out[d0:d1] = np.asarray(final(acc_shares, acc_mask))
+                final = self._finals[ds_pad] = self._final_fn(ds_pad)
+            out[d0:d1] = np.asarray(final(acc_shares, acc_mask))[:d_size]
         return out
 
     def aggregate(self, inputs, key=None) -> np.ndarray:

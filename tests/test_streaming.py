@@ -76,9 +76,16 @@ def test_synthetic_provider_consistent_across_tilings():
     np.testing.assert_array_equal(out, prov(0, 6, 0, 12).sum(axis=0) % 433)
 
 
-def test_dim_chunk_must_align_with_packing():
-    with pytest.raises(ValueError, match="divisible by secret_count"):
-        StreamingAggregator(GOLDEN, dim_chunk=10)
+def test_dim_chunk_rounds_up_to_scheme_grain():
+    # misaligned tile sizes round up to the packing (and, with ChaCha,
+    # the 8-word block) grain instead of erroring
+    assert StreamingAggregator(GOLDEN, dim_chunk=10).dim_chunk == 12
+    from sda_tpu.protocol import ChaChaMasking
+
+    agg = StreamingAggregator(
+        GOLDEN, ChaChaMasking(433, 100, 128), dim_chunk=10
+    )
+    assert agg.dim_chunk == 24  # lcm(secret_count=3, chacha block 8)
 
 
 # ---------------------------------------------------------------------------
@@ -147,3 +154,26 @@ def test_streamed_pod_large_committee_smoke():
     inputs = rng.integers(0, 433, size=(12, 20))
     out = pod.aggregate(inputs, key=jax.random.PRNGKey(2))
     np.testing.assert_array_equal(out, inputs.sum(axis=0) % 433)
+
+
+def test_streaming_aggregator_chacha_exact_across_tilings():
+    """ChaCha seed masks in the single-chip streamed mode: exact aggregate
+    for several tilings, including edge tiles not aligned to the 8-word
+    ChaCha block grain (the dim tile pads to the grain internally)."""
+    import jax
+
+    from sda_tpu.mesh import StreamingAggregator
+    from sda_tpu.protocol import ChaChaMasking, PackedShamirSharing
+
+    s = PackedShamirSharing(3, 8, 4, 433, 354, 150)
+    rng = np.random.default_rng(41)
+    P, d = 13, 100  # d % 24 != 0: every tiling has a ragged edge tile
+    x = rng.integers(0, 433, size=(P, d))
+    expected = x.sum(axis=0) % 433
+    for pc, dc in [(4, 24), (5, 48), (13, 120), (2, 25)]:
+        agg = StreamingAggregator(
+            s, ChaChaMasking(433, d, 128),
+            participants_chunk=pc, dim_chunk=dc,
+        )
+        out = agg.aggregate(x, key=jax.random.PRNGKey(12))
+        np.testing.assert_array_equal(out, expected, err_msg=f"tiling {pc}x{dc}")

@@ -26,8 +26,8 @@ use_platform("axon")
 import jax, jax.numpy as jnp
 from sda_tpu.fields import numtheory
 from sda_tpu.fields.pallas_round import single_chip_round_pallas
-from sda_tpu.mesh import single_chip_round
-from sda_tpu.protocol import FullMasking, PackedShamirSharing
+from sda_tpu.mesh import SimulatedPod, StreamingAggregator, make_mesh, single_chip_round
+from sda_tpu.protocol import ChaChaMasking, FullMasking, PackedShamirSharing
 
 t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
 scheme = PackedShamirSharing(3, 8, t, p, w2, w3)
@@ -39,6 +39,14 @@ for build in (single_chip_round, single_chip_round_pallas):
     fn = jax.jit(build(scheme, FullMasking(p)))
     out = jax.device_get(fn(inputs, key))
     assert np.array_equal(out, expected), f"{build.__name__} wrong on TPU"
+# device-ChaCha seed masks and the degenerate 1x1 pod, on hardware
+fnc = jax.jit(single_chip_round(scheme, ChaChaMasking(p, 6144, 128)))
+assert np.array_equal(jax.device_get(fnc(inputs, key)), expected)
+pod = SimulatedPod(scheme, FullMasking(p), mesh=make_mesh(1, 1))
+assert np.array_equal(np.asarray(pod.aggregate(np.asarray(inputs), key=key)), expected)
+agg = StreamingAggregator(scheme, ChaChaMasking(p, 6144, 128),
+                          participants_chunk=8, dim_chunk=3072)
+assert np.array_equal(agg.aggregate(np.asarray(inputs), key=key), expected)
 print("TPU_EXACT_OK")
 """
 
