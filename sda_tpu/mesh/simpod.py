@@ -122,6 +122,26 @@ def default_mesh_shape(n_devices: int, share_count: int) -> Tuple[int, int]:
 #: fold_in tag separating the ChaCha-seed key stream from share randomness
 _SEED_TAG = 0x5EED
 
+#: fold_in tag separating per-device/tile driver keys from the seed stream:
+#: without it, a tile index equal to _SEED_TAG would alias the tile's
+#: share/mask randomness onto the ChaCha seed-word PRF stream
+_TILE_TAG = 0x711E
+
+
+def _tile_key(round_key, *indices):
+    """Per-device/tile randomness key, domain-separated from _SEED_TAG."""
+    k = jax.random.fold_in(round_key, _TILE_TAG)
+    for ix in indices:
+        k = jax.random.fold_in(k, ix)
+    return k
+
+
+def _check_masking_supported(masking) -> None:
+    if not isinstance(masking, (NoMasking, FullMasking, ChaChaMasking)):
+        raise ValueError(
+            f"unsupported masking scheme {type(masking).__name__}"
+        )
+
 
 def _chacha_seed_words(key, global_ids, seed_bitsize: int):
     """[S] global participant ids -> [S, 8] uint32 seed words.
@@ -305,10 +325,7 @@ class SimulatedPod:
         self.scheme = sharing_scheme
         self.modulus = _scheme_modulus(sharing_scheme)
         self.masking = masking_scheme or NoMasking()
-        if not isinstance(self.masking, (NoMasking, FullMasking, ChaChaMasking)):
-            raise ValueError(
-                f"unsupported masking scheme {type(self.masking).__name__}"
-            )
+        _check_masking_supported(self.masking)
         _check_mask_modulus(self.masking, sharing_scheme)
         if mesh is None:
             p_shards, d_shards = default_mesh_shape(
@@ -341,9 +358,10 @@ class SimulatedPod:
         P_loc, d_loc = inputs.shape
         pi = jax.lax.axis_index("p")
         di = jax.lax.axis_index("d")
-        # distinct randomness per device block; ChaCha seeds fold the raw
-        # round key so every dim shard derives the same per-participant seed
-        dev_key = jax.random.fold_in(jax.random.fold_in(key, pi), di)
+        # distinct randomness per device block, domain-separated from the
+        # ChaCha seed stream; seeds fold the raw round key so every dim
+        # shard derives the same per-participant seed
+        dev_key = _tile_key(key, pi, di)
 
         x = f.to_residues(inputs)
         # participant parallelism -> local scan-chunked reduction (share

@@ -38,25 +38,24 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..fields import fastfield, modular, numtheory, sharing
 from ..fields.ops import FieldOps
 from ..protocol import (
     ChaChaMasking,
     FullMasking,
     LinearMaskingScheme,
     NoMasking,
-    PackedShamirSharing,
 )
 from .simpod import (
     _check_collective_headroom,
     _check_mask_modulus,
+    _check_masking_supported,
     _dim_grain,
     _build_matrices,
     _mask_stage,
     _reconstruct_stage,
     _scheme_modulus,
     _share_sum_stage,
-    _to_residues32,
+    _tile_key,
 )
 
 #: get_block(p0, p1, d0, d1) -> integer array [p1-p0, d1-d0]
@@ -105,42 +104,31 @@ def synthetic_block_provider(
 class StreamingAggregator:
     """Chunked single-chip rounds: fixed device memory for any P and d.
 
-    Full masking-scheme coverage: none/full/chacha — ChaCha seed masks
-    are expanded on device per tile at the tile's (participant, dim)
-    offset, so every tiling of the same round key sees the same masks.
+    Full scheme-lattice coverage like the pod modes: Packed-Shamir OR
+    additive sharing x none/full/chacha masking — ChaCha seed masks are
+    expanded on device per tile at the tile's (participant, dim) offset,
+    so every tiling of the same round key sees the same masks.
     """
 
     def __init__(
         self,
-        sharing_scheme: PackedShamirSharing,
+        sharing_scheme,
         masking_scheme: Optional[LinearMaskingScheme] = None,
         participants_chunk: int = 64,
         dim_chunk: int = 3 * (1 << 20),
     ):
-        if not isinstance(sharing_scheme, PackedShamirSharing):
-            raise ValueError("StreamingAggregator runs Packed-Shamir rounds")
         self.scheme = s = sharing_scheme
+        self.modulus = _scheme_modulus(s)  # also validates the scheme type
         self.masking = masking_scheme or NoMasking()
-        if not isinstance(self.masking, (NoMasking, FullMasking, ChaChaMasking)):
-            raise ValueError(
-                f"unsupported masking scheme {type(self.masking).__name__}"
-            )
+        _check_masking_supported(self.masking)
         _check_mask_modulus(self.masking, s)
         # ChaCha seed masks expand a window of one per-participant stream at
         # each tile's dim offset, so tiles align to the 8-word block grain
         self._grain = _dim_grain(s, self.masking)
         self.participants_chunk = int(participants_chunk)
         self.dim_chunk = -(-int(dim_chunk) // self._grain) * self._grain
-        self._M_host = numtheory.packed_share_matrix(
-            s.secret_count, s.share_count, s.privacy_threshold,
-            s.prime_modulus, s.omega_secrets, s.omega_shares,
-        )
-        self._L_host = numtheory.packed_reconstruct_matrix(
-            s.secret_count, s.share_count, s.privacy_threshold,
-            s.prime_modulus, s.omega_secrets, s.omega_shares,
-            tuple(range(s.share_count)),
-        )
-        self._field = FieldOps.create(s.prime_modulus)
+        self._M_host, self._L_host = _build_matrices(s)  # None for additive
+        self._field = FieldOps.create(self.modulus)
         self._sp = self._field.sp
         self._steps = {}      # block shape -> jitted accumulate step
         self._finals = {}     # dim size -> jitted reconstruct+unmask
@@ -171,31 +159,14 @@ class StreamingAggregator:
         return jax.jit(step, donate_argnums=(5, 6))
 
     def _final_fn(self, d_size):
-        s, sp = self.scheme, self._sp
-        p = s.prime_modulus
+        s, f = self.scheme, self._field
         mask = not isinstance(self.masking, NoMasking)
-        L_host = self._L_host
 
-        if sp is not None:
-
-            def final(acc_shares, acc_mask):
-                total = sharing.packed_reconstruct32(
-                    acc_shares, L_host, sp, dimension=d_size
-                )
-                if mask:
-                    total = fastfield.modsub32(total, acc_mask, sp)
-                return total.astype(jnp.int64)
-
-        else:
-            L = jnp.asarray(L_host)
-
-            def final(acc_shares, acc_mask):
-                total = sharing.packed_reconstruct(
-                    acc_shares, L, prime=p, dimension=d_size
-                )
-                if mask:
-                    total = modular.modsub(total, acc_mask, p)
-                return total
+        def final(acc_shares, acc_mask):
+            total = _reconstruct_stage(s, f, self._L_host, acc_shares, d_size)
+            if mask:
+                total = f.sub(total, acc_mask)
+            return f.to_int64(total)
 
         return jax.jit(final, donate_argnums=(0, 1))
 
@@ -205,19 +176,18 @@ class StreamingAggregator:
     ) -> np.ndarray:
         """Stream all blocks; returns the [dimension] aggregate (host array)."""
         s = self.scheme
-        p = s.prime_modulus
         if key is None:
             from ..crypto.core import fresh_prng_key
 
             key = fresh_prng_key()
-        acc_dtype = jnp.uint32 if self._sp is not None else jnp.int64
+        acc_dtype = self._field.dtype
         out = np.empty(dimension, dtype=np.int64)
         for di, d0 in enumerate(range(0, dimension, self.dim_chunk)):
             d1 = min(d0 + self.dim_chunk, dimension)
             d_size = d1 - d0
             ds_pad = -(-d_size // self._grain) * self._grain  # edge tile
-            B = ds_pad // s.secret_count
-            acc_shares = jnp.zeros((s.share_count, B), acc_dtype)
+            B = ds_pad // s.input_size
+            acc_shares = jnp.zeros((s.output_size, B), acc_dtype)
             acc_mask = jnp.zeros((ds_pad,), acc_dtype)
             for pi, p0 in enumerate(range(0, participants, self.participants_chunk)):
                 p1 = min(p0 + self.participants_chunk, participants)
@@ -227,7 +197,7 @@ class StreamingAggregator:
                     padded[:, :d_size] = host
                     host = padded
                 block = jnp.asarray(host)
-                bkey = jax.random.fold_in(jax.random.fold_in(key, pi), di)
+                bkey = _tile_key(key, pi, di)
                 step = self._steps.get(block.shape)
                 if step is None:
                     step = self._steps[block.shape] = self._step_fn(block.shape)
@@ -272,10 +242,7 @@ class StreamedPod:
         self.scheme = s = sharing_scheme
         self.modulus = _scheme_modulus(s)
         self.masking = masking_scheme or NoMasking()
-        if not isinstance(self.masking, (NoMasking, FullMasking, ChaChaMasking)):
-            raise ValueError(
-                f"unsupported masking scheme {type(self.masking).__name__}"
-            )
+        _check_masking_supported(self.masking)
         _check_mask_modulus(self.masking, s)
         if mesh is None:
             p_shards, d_shards = default_mesh_shape(
@@ -401,9 +368,7 @@ class StreamedPod:
                     padded[: host.shape[0], : host.shape[1]] = host
                     host = padded
                 block = jax.device_put(jnp.asarray(host), sharding)
-                tile_key = jax.random.fold_in(
-                    jax.random.fold_in(key, pi_ix), di_ix
-                )
+                tile_key = _tile_key(key, pi_ix, di_ix)
                 step = self._steps.get(host.shape)
                 if step is None:
                     step = self._steps[host.shape] = self._step_fn(host.shape)

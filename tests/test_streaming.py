@@ -177,3 +177,31 @@ def test_streaming_aggregator_chacha_exact_across_tilings():
         )
         out = agg.aggregate(x, key=jax.random.PRNGKey(12))
         np.testing.assert_array_equal(out, expected, err_msg=f"tiling {pc}x{dc}")
+
+
+def test_streaming_aggregator_additive_schemes():
+    """Additive sharing in the streamed single-chip mode (scheme-lattice
+    parity with the pod modes), across maskings and ragged tilings."""
+    import jax
+
+    from sda_tpu.mesh import StreamingAggregator
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        ChaChaMasking,
+        FullMasking,
+        NoMasking,
+    )
+
+    rng = np.random.default_rng(53)
+    P, d = 11, 70
+    x = rng.integers(0, 433, size=(P, d))
+    expected = x.sum(axis=0) % 433
+    s = AdditiveSharing(share_count=8, modulus=433)
+    for masking in (NoMasking(), FullMasking(433), ChaChaMasking(433, d, 128)):
+        agg = StreamingAggregator(
+            s, masking, participants_chunk=4, dim_chunk=30
+        )
+        out = agg.aggregate(x, key=jax.random.PRNGKey(21))
+        np.testing.assert_array_equal(
+            out, expected, err_msg=type(masking).__name__
+        )

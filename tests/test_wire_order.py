@@ -1,0 +1,116 @@
+"""Mechanical wire-format cross-check against the reference source.
+
+The golden serde vectors in test_protocol.py were hand-derived from
+reading the Rust; a single mis-read field order would break
+cross-implementation signatures undetectably (canonical-JSON signing
+serializes fields in declaration order — helpers.rs:101-142). This test
+removes the single point of failure by deriving the field order a SECOND
+way: parse the reference's struct/enum-variant declarations straight out
+of `/root/reference/protocol/src/*.rs` (treated as data, not code) and
+compare against the key order our ``to_obj`` dict literals emit,
+extracted via ``ast`` from our own source. Both sides are obtained
+mechanically, so agreement means our wire order matches the reference's
+serde declaration order field-for-field.
+"""
+
+import ast
+import inspect
+import re
+from pathlib import Path
+
+import pytest
+
+import sda_tpu.protocol.helpers as helpers_mod
+import sda_tpu.protocol.resources as resources_mod
+import sda_tpu.protocol.crypto as crypto_mod
+
+REF = Path("/root/reference/protocol/src")
+
+pytestmark = pytest.mark.skipif(
+    not REF.exists(), reason="reference checkout not present"
+)
+
+
+# -- reference side: parse `pub struct` / enum struct-variant fields -------
+
+def rust_struct_fields(source: str):
+    """{struct_name: [field, ...]} for every `pub struct Name { pub f: T }`."""
+    out = {}
+    for m in re.finditer(
+        r"pub struct (\w+)(?:<[^>]*>)?\s*(?:where[^{]*)?\{(.*?)\n\}",
+        source, re.S,
+    ):
+        fields = re.findall(r"pub (\w+)\s*:", m.group(2))
+        if fields:
+            out[m.group(1)] = fields
+    return out
+
+
+def rust_variant_fields(source: str):
+    """{variant_name: [field, ...]} for struct-like enum variants.
+
+    Commented-out variants (e.g. BasicShamir, PackedPaillier) are
+    stripped first so they do not shadow live declarations.
+    """
+    live = re.sub(r"(?m)^\s*//.*$", "", source)
+    out = {}
+    for m in re.finditer(r"(?m)^    (\w+)\s*\{([^}]*)\}", live):
+        fields = re.findall(r"(\w+)\s*:", m.group(2))
+        if fields:
+            out[m.group(1)] = fields
+    return out
+
+
+# -- our side: first dict literal returned by to_obj, via ast --------------
+
+def to_obj_key_order(cls):
+    """Key order of the dict literal(s) in ``cls.to_obj``.
+
+    Returns the outer dict's keys; if the outer dict is a single-key
+    externally-tagged wrapper ({"Variant": {...}}) returns the inner
+    dict's keys instead (serde external enum tagging).
+    """
+    tree = ast.parse(inspect.getsource(cls.to_obj).lstrip())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+            d = node.value
+            keys = [k.value for k in d.keys if isinstance(k, ast.Constant)]
+            if len(keys) == 1 and isinstance(d.values[0], ast.Dict):
+                inner = d.values[0]
+                return [k.value for k in inner.keys if isinstance(k, ast.Constant)]
+            return keys
+    raise AssertionError(f"{cls.__name__}.to_obj has no dict-literal return")
+
+
+# -- the cross-checks ------------------------------------------------------
+
+def test_resource_structs_match_reference_field_order():
+    ref = rust_struct_fields((REF / "resources.rs").read_text())
+    checked = 0
+    for name, fields in ref.items():
+        cls = getattr(resources_mod, name, None)
+        assert cls is not None, f"reference struct {name} has no counterpart"
+        assert to_obj_key_order(cls) == fields, f"{name} wire order diverges"
+        checked += 1
+    assert checked >= 10  # all protocol nouns present in resources.rs
+
+
+def test_helper_structs_match_reference_field_order():
+    ref = rust_struct_fields((REF / "helpers.rs").read_text())
+    for name in ("Signed", "Labelled"):
+        cls = getattr(helpers_mod, name)
+        assert to_obj_key_order(cls) == ref[name], f"{name} wire order diverges"
+
+
+def test_scheme_variants_match_reference_field_order():
+    ref = rust_variant_fields((REF / "crypto.rs").read_text())
+    ours = {
+        "Full": crypto_mod.FullMasking,
+        "ChaCha": crypto_mod.ChaChaMasking,
+        "Additive": crypto_mod.AdditiveSharing,
+        "PackedShamir": crypto_mod.PackedShamirSharing,
+    }
+    for variant, cls in ours.items():
+        assert to_obj_key_order(cls) == ref[variant], (
+            f"{variant} wire order diverges"
+        )
