@@ -1,0 +1,118 @@
+"""One-shot real-TPU revalidation: probe, exactness smoke, headline timings.
+
+The axon tunnel is flaky (it died mid-round-2 after ~3h up), so hardware
+evidence must be grabbed quickly whenever the chip answers. This script
+does the full pass in one process:
+
+    python benchmarks/hw_check.py            # probe + smoke + timings
+    SDA_HW_SMOKE_ONLY=1 python benchmarks/hw_check.py
+
+Prints one JSON line per stage; exits 0 only if every stage that ran
+passed. Does NOT write BENCH_SUITE.json — run benchmarks/suite.py for
+the recorded configs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sda_tpu.utils.backend import probe_tpu, use_platform
+
+
+def _emit(stage: str, **kw) -> None:
+    print(json.dumps({"stage": stage, **kw}), flush=True)
+
+
+def main() -> int:
+    if not probe_tpu(
+        float(os.environ.get("SDA_HW_PROBE_TIMEOUT", 120)),
+        attempts=int(os.environ.get("SDA_HW_PROBE_ATTEMPTS", 1)),
+    ):
+        _emit("probe", ok=False, detail="TPU probe timed out; tunnel down")
+        return 1
+    _emit("probe", ok=True)
+    use_platform("axon")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sda_tpu.fields import numtheory
+    from sda_tpu.fields.pallas_round import single_chip_round_pallas
+    from sda_tpu.mesh import (
+        SimulatedPod,
+        StreamingAggregator,
+        make_mesh,
+        single_chip_round,
+    )
+    from sda_tpu.protocol import ChaChaMasking, FullMasking, PackedShamirSharing
+    from sda_tpu.utils.benchtime import marginal_seconds
+
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    scheme = PackedShamirSharing(3, 8, t, p, w2, w3)
+    key = jax.random.PRNGKey(11)
+    rng = np.random.default_rng(11)
+    ok = True
+
+    # -- exactness smoke (small shapes, every execution surface) ----------
+    # host copies + expected sums computed once, BEFORE any device upload:
+    # no D2H refetches over the flaky tunnel
+    host_small = rng.integers(0, 1 << 20, size=(24, 6144), dtype=np.uint32)
+    small = jnp.asarray(host_small)
+    expected = host_small.astype(np.int64).sum(axis=0) % p
+    surfaces = [
+        ("xla_round", lambda: jax.jit(single_chip_round(scheme, FullMasking(p)))(small, key)),
+        ("pallas_round", lambda: jax.jit(single_chip_round_pallas(scheme, FullMasking(p)))(small, key)),
+        ("chacha_round", lambda: jax.jit(single_chip_round(scheme, ChaChaMasking(p, 6144, 128)))(small, key)),
+        ("pod_1x1", lambda: SimulatedPod(scheme, FullMasking(p), mesh=make_mesh(1, 1)).aggregate(host_small, key=key)),
+        ("streaming_chacha", lambda: StreamingAggregator(
+            scheme, ChaChaMasking(p, 6144, 128), participants_chunk=8,
+            dim_chunk=3072).aggregate(host_small, key=key)),
+    ]
+    for name, run in surfaces:
+        try:
+            out = np.asarray(jax.device_get(run()))
+            exact = bool(np.array_equal(out, expected))
+        except Exception as e:  # keep checking the other surfaces
+            _emit("smoke", surface=name, ok=False,
+                  error=f"{type(e).__name__}: {str(e)[:300]}")
+            ok = False
+            continue
+        _emit("smoke", surface=name, ok=exact)
+        ok = ok and exact
+    if os.environ.get("SDA_HW_SMOKE_ONLY") == "1":
+        return 0 if ok else 1
+
+    # -- headline timings (marginal method; see utils/benchtime.py) -------
+    P, d = 100, 999_999
+    host_big = rng.integers(0, 1 << 20, size=(P, d), dtype=np.uint32)
+    expected_big = host_big.astype(np.int64).sum(axis=0) % p
+    big = jnp.asarray(host_big)
+    for name, build in [
+        ("pallas", lambda: single_chip_round_pallas(scheme, FullMasking(p))),
+        ("xla", lambda: single_chip_round(scheme, FullMasking(p))),
+    ]:
+        try:
+            fn = jax.jit(build())
+            out = jax.device_get(fn(big, key))
+            exact = bool(np.array_equal(out, expected_big))
+            per, info = marginal_seconds(
+                lambda i: fn(big, jax.random.fold_in(key, i)), target_seconds=6
+            )
+            _emit("timing", path=name, ok=exact,
+                  ms_per_round=round(per * 1000, 2),
+                  gel_per_sec=round(P * d / per / 1e9, 2), **info)
+            ok = ok and exact
+        except Exception as e:
+            _emit("timing", path=name, ok=False,
+                  error=f"{type(e).__name__}: {str(e)[:300]}")
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
