@@ -92,7 +92,7 @@ def _run(platform: str, use_pallas: bool) -> dict:
     _log(f"marginal round: {per_round*1000:.2f} ms ({timing})")
 
     value = participants * dim / per_round
-    return {
+    result = {
         "metric": "secure-aggregated shared-elements/sec/chip "
         "(Packed-Shamir n=8 t=%d p=%d, full mask, %d x %d)"
         % (t, p, participants, dim),
@@ -106,6 +106,42 @@ def _run(platform: str, use_pallas: bool) -> dict:
         "compile_seconds": round(compile_s, 1),
         **timing,
     }
+    if not on_tpu:
+        # CPU fallback (tunnel down): point at the committed real-chip
+        # record so the fallback number is not mistaken for chip perf
+        rec = _recorded_tpu_result()
+        if rec is not None:
+            result["recorded_tpu"] = rec
+    return result
+
+
+def _recorded_tpu_result():
+    """The committed real-chip flagship number (BENCH_SUITE.json), if any.
+
+    Best-effort annotation: must NEVER break the bench (the caller just
+    measured successfully), so any surprise in the file shape returns
+    None instead of raising; suite failure records (an "error" key, no
+    numeric value) are not real-chip results and never match.
+    """
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_SUITE.json")) as f:
+            data = json.load(f)
+        for r in data.get("results", []):
+            if (r.get("config") == "packed-1m"
+                    and r.get("platform") == "tpu"
+                    and "error" not in r
+                    and isinstance(r.get("value"), (int, float))):
+                return {
+                    "note": "real-chip result recorded in BENCH_SUITE.json "
+                            "while the TPU tunnel was up",
+                    "value": r["value"],
+                    "unit": r.get("unit"),
+                    "vs_baseline": round(r["value"] / 1e9, 4),
+                }
+    except Exception:
+        pass
+    return None
 
 
 def main() -> None:
