@@ -27,6 +27,9 @@ import json
 import os
 import time
 
+#: the driver's north-star target (BASELINE.json): 1e9 shared-elements/sec
+_NORTH_STAR = 1e9
+
 from sda_tpu.utils.backend import log as _log
 from sda_tpu.utils.backend import select_platform as _select_platform
 from sda_tpu.utils.backend import use_platform
@@ -98,7 +101,7 @@ def _run(platform: str, use_pallas: bool) -> dict:
         % (t, p, participants, dim),
         "value": round(value),
         "unit": "elements/sec",
-        "vs_baseline": round(value / 1e9, 4),
+        "vs_baseline": round(value / _NORTH_STAR, 4),
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
         "pallas": use_pallas,
@@ -137,7 +140,7 @@ def _recorded_tpu_result():
                             "while the TPU tunnel was up",
                     "value": r["value"],
                     "unit": r.get("unit"),
-                    "vs_baseline": round(r["value"] / 1e9, 4),
+                    "vs_baseline": round(r["value"] / _NORTH_STAR, 4),
                 }
     except Exception:
         pass

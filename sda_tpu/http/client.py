@@ -11,6 +11,7 @@ maps to ``None``; a bare 404 is a routing error (client.rs:65-72).
 from __future__ import annotations
 
 import secrets as _secrets
+import threading as _threading
 from typing import List, Optional
 
 import requests
@@ -50,22 +51,56 @@ def _load_or_mint_token(store, agent_id: AgentId) -> str:
 
 
 class SdaHttpClient(SdaService):
+    """REST proxy implementing the full SdaService seam.
+
+    Thread-safe: one proxy can serve many agents from many threads (the
+    in-process tests drive concurrent clerks through one instance).
+    ``requests.Session`` connection reuse is NOT safe across threads —
+    interleaved request/response framing deadlocks both ends — so each
+    thread gets its own session; the token cache is lock-guarded.
+    """
+
     def __init__(self, base_url: str, store=None, token: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.store = store
         self._fixed_token = token
         self._tokens = {}  # per-caller cache; one proxy can serve many agents
-        self.session = requests.Session()
+        self._tokens_lock = _threading.Lock()
+        self._local = _threading.local()
+        self._sessions = []  # every created session, for close()
+
+    @property
+    def session(self) -> requests.Session:
+        s = getattr(self._local, "session", None)
+        if s is None:
+            s = self._local.session = requests.Session()
+            with self._tokens_lock:
+                self._sessions.append(s)
+        return s
+
+    def close(self) -> None:
+        """Release pooled keep-alive sockets of every thread's session."""
+        with self._tokens_lock:
+            sessions, self._sessions = self._sessions, []
+        for s in sessions:
+            s.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def _auth(self, caller: Agent):
         if self._fixed_token is not None:
             return (str(caller.id), self._fixed_token)
-        token = self._tokens.get(caller.id)
-        if token is None:
-            if self.store is None:
-                raise InvalidCredentials("no token store configured")
-            token = _load_or_mint_token(self.store, caller.id)
-            self._tokens[caller.id] = token
+        with self._tokens_lock:
+            token = self._tokens.get(caller.id)
+            if token is None:
+                if self.store is None:
+                    raise InvalidCredentials("no token store configured")
+                token = _load_or_mint_token(self.store, caller.id)
+                self._tokens[caller.id] = token
         return (str(caller.id), token)
 
     def _check(self, response: requests.Response):

@@ -161,3 +161,67 @@ def test_concurrent_agent_registration(service):
     assert not errors
     for a in agents:
         assert service.get_agent(a, a.id) == a
+
+
+def test_http_transport_concurrent_job_polling(tmp_path):
+    """The REST seam under contention: get_clerking_job is a POLL (the job
+    stays queued until its result lands — reference semantics,
+    clerking_jobs.rs), so competing pollers per clerk must all see the
+    same job, racing result uploads must settle exactly-once, and the
+    queue must then read empty for everyone (ThreadingHTTPServer +
+    per-thread client sessions; reference analog is rouille's thread
+    pool, server-http/src/lib.rs)."""
+    from sda_tpu.http import SdaHttpClient, SdaHttpServer
+    from sda_tpu.protocol import ClerkingResult
+    from sda_tpu.store import Filebased
+
+    http_server = SdaHttpServer(new_memory_server(), bind="127.0.0.1:0").start_background()
+    try:
+        service = SdaHttpClient(http_server.address, store=Filebased(tmp_path / "tokens"))
+        recipient, committee, agg = _world(service, clerks=4)
+        for _ in range(12):
+            _participate(service, agg, committee)
+        snap = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+        service.create_snapshot(recipient, snap)
+
+        polled, errors = [], []
+        lock = threading.Lock()
+
+        def clerk_worker(clerk):
+            try:
+                job = service.get_clerking_job(clerk, clerk.id)
+                if job is not None:
+                    with lock:
+                        polled.append((clerk.id, job.id))
+                    service.create_clerking_result(clerk, ClerkingResult(
+                        job=job.id, clerk=clerk.id,
+                        encryption=mock_encryption(b"sum"),
+                    ))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=clerk_worker, args=(clerk,))
+            for (clerk, _) in committee
+            for _ in range(3)          # 3 competing workers per clerk
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "worker hung"
+        assert not errors
+        # every competing poller of one clerk saw that clerk's single job
+        jobs_by_clerk = {}
+        for clerk_id, job_id in polled:
+            jobs_by_clerk.setdefault(clerk_id, set()).add(job_id)
+        assert all(len(v) == 1 for v in jobs_by_clerk.values()), jobs_by_clerk
+        # duplicate racing results settled exactly-once: 4 results, ready
+        status = service.get_aggregation_status(recipient, agg.id)
+        assert status.snapshots[0].number_of_clerking_results == len(committee)
+        assert status.snapshots[0].result_ready
+        # and the queue reads empty over HTTP for every clerk
+        for (clerk, _) in committee:
+            assert service.get_clerking_job(clerk, clerk.id) is None
+    finally:
+        http_server.shutdown()
