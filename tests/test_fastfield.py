@@ -89,6 +89,21 @@ def test_modmatmul32_worst_case(sp):
         np.testing.assert_array_equal(got.astype(object), exp)
 
 
+def test_np_oracle_matches_bigint(sp):
+    """np_modmatmul32 (the module's own NumPy oracle) must agree with the
+    exact bigint product — it is what audits device results elsewhere."""
+    rng = np.random.default_rng(9)
+    p = sp.p
+    M = rng.integers(0, p, size=(8, 7))
+    V = rng.integers(0, p, size=(7, 65)).astype(np.uint32)
+    got = ff.np_modmatmul32(M, V, sp)
+    exp = (M.astype(object) @ V.astype(object)) % p
+    np.testing.assert_array_equal(got.astype(object), exp)
+    # and the device kernel agrees with the oracle
+    dev = np.asarray(ff.modmatmul32(M, jnp.asarray(V), sp))
+    np.testing.assert_array_equal(dev, got)
+
+
 def test_modmatmul32_batched(sp):
     rng = np.random.default_rng(4)
     p = sp.p
