@@ -86,3 +86,31 @@ def test_large_committee_scheme_round():
     inputs = rng.integers(0, 1 << 16, size=(4, 11 * 7))
     out = np.asarray(fn(jax.numpy.asarray(inputs), jax.random.PRNGKey(6)))
     np.testing.assert_array_equal(out, inputs.sum(axis=0) % p)
+
+
+@pytest.mark.parametrize("P", [1, 2])
+def test_single_participant_edge(P):
+    """P=1/P=2 rounds: the smallest participant counts exercise pb-clamp
+    and single-term folds in every single-chip path."""
+    import jax.numpy as jnp
+
+    from sda_tpu.fields.pallas_round import single_chip_round_pallas
+    from sda_tpu.mesh import StreamingAggregator
+
+    s = fast_scheme()
+    p = s.prime_modulus
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 1 << 20, size=(P, 384)).astype(np.uint32)
+    exp = x.astype(np.int64).sum(axis=0) % p
+    key = jax.random.PRNGKey(1)
+    ext = lambda k, n, d, B: jax.random.bits(k, (n, 2 * d, B), dtype=jnp.uint32)
+
+    out_xla = jax.jit(single_chip_round(s, FullMasking(p)))(jnp.asarray(x), key)
+    out_pl = single_chip_round_pallas(
+        s, FullMasking(p), tile=128, interpret=True, external_bits_fn=ext
+    )(jnp.asarray(x), key)
+    out_st = StreamingAggregator(
+        s, FullMasking(p), participants_chunk=1, dim_chunk=96
+    ).aggregate(x, key=key)
+    for name, out in [("xla", out_xla), ("pallas", out_pl), ("streaming", out_st)]:
+        np.testing.assert_array_equal(np.asarray(out), exp, err_msg=name)
