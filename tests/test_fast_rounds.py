@@ -102,8 +102,9 @@ def test_single_participant_edge(P):
     rng = np.random.default_rng(2)
     x = rng.integers(0, 1 << 20, size=(P, 384)).astype(np.uint32)
     exp = x.astype(np.int64).sum(axis=0) % p
+    from util import external_bits as ext
+
     key = jax.random.PRNGKey(1)
-    ext = lambda k, n, d, B: jax.random.bits(k, (n, 2 * d, B), dtype=jnp.uint32)
 
     out_xla = jax.jit(single_chip_round(s, FullMasking(p)))(jnp.asarray(x), key)
     out_pl = single_chip_round_pallas(

@@ -27,8 +27,7 @@ def fast_scheme():
     return PackedShamirSharing(3, 8, t, p, w2, w3)
 
 
-def external_bits(key, P, draws, B):
-    return jax.random.bits(key, (P, 2 * draws, B), dtype=jnp.uint32)
+from util import external_bits
 
 
 @pytest.mark.parametrize("masking", ["none", "full"])

@@ -115,3 +115,13 @@ def scheme_lattice_config(name, dim, *, additive_share_count=8):
         "chacha": ChaChaMasking(433, dim, 128),
     }[name.split("-")[1]]
     return sharing, masking
+
+
+def external_bits(key, P, draws, B):
+    """[P, 2*draws, B] uint32 pre-drawn bits for the Pallas round's
+    external-randomness mode (layout contract: pallas_round.py) — shared
+    by the interpret-mode kernel tests."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.random.bits(key, (P, 2 * draws, B), dtype=jnp.uint32)
