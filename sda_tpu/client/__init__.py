@@ -70,8 +70,10 @@ class SdaClient:
     def upload_agent(self) -> None:
         self.service.create_agent(self.agent, self.agent)
 
-    def new_encryption_key(self) -> EncryptionKeyId:
-        return self.crypto.new_encryption_key()
+    def new_encryption_key(self, scheme=None) -> EncryptionKeyId:
+        """Fresh keypair in the keystore; ``scheme`` picks the key type
+        (None/Sodium -> Curve25519, PackedPaillierEncryption -> Paillier)."""
+        return self.crypto.new_encryption_key(scheme)
 
     def upload_encryption_key(self, key: EncryptionKeyId) -> None:
         signed = self.crypto.sign_export(self.agent, key)

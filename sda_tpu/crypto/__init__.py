@@ -18,7 +18,8 @@ from ..protocol import (
     Signed,
     VerificationKeyId,
 )
-from . import encryption, masking, sharing, signing, sodium, varint
+from . import encryption, masking, paillier, sharing, signing, sodium, varint
+from .encryption import paillier_combine
 from .core import (
     DecryptionKey,
     EncryptionKeypair,
@@ -37,8 +38,10 @@ class CryptoModule:
         self.keystore = keystore
 
     # -- key generation ----------------------------------------------------
-    def new_encryption_key(self) -> EncryptionKeyId:
-        keypair = encryption.new_encryption_keypair()
+    def new_encryption_key(self, scheme=None) -> EncryptionKeyId:
+        """Fresh encryption keypair; ``scheme`` selects the key type
+        (default Sodium/Curve25519, PackedPaillierEncryption for Paillier)."""
+        keypair = encryption.new_encryption_keypair(scheme)
         key_id = EncryptionKeyId.random()
         self.keystore.put_encryption_keypair(key_id, keypair)
         return key_id

@@ -17,6 +17,7 @@ import jax
 from ..protocol import (
     B32,
     B64,
+    Binary,
     EncryptionKey,
     EncryptionKeyId,
     SigningKey,
@@ -26,11 +27,16 @@ from ..protocol import (
 
 
 class DecryptionKey:
-    """Secret half of an encryption keypair (Curve25519, 32 bytes)."""
+    """Secret half of an encryption keypair: 32-byte Curve25519 for
+    ``Sodium``, a length-framed (p, q) factorisation for ``PackedPaillier``."""
 
     __slots__ = ("variant", "value")
 
-    def __init__(self, variant: str, value: B32):
+    _PAYLOADS = {"Sodium": B32, "PackedPaillier": Binary}
+
+    def __init__(self, variant: str, value):
+        if variant not in self._PAYLOADS:
+            raise ValueError(f"unknown decryption key variant {variant!r}")
         self.variant = variant
         self.value = value
 
@@ -40,7 +46,9 @@ class DecryptionKey:
     @classmethod
     def from_obj(cls, obj):
         [(variant, payload)] = obj.items()
-        return cls(variant, B32.from_obj(payload))
+        if variant not in cls._PAYLOADS:
+            raise ValueError(f"unknown decryption key variant {variant!r}")
+        return cls(variant, cls._PAYLOADS[variant].from_obj(payload))
 
 
 class EncryptionKeypair:

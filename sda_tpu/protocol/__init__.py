@@ -28,6 +28,7 @@ from .crypto import (
     LinearMaskingScheme,
     LinearSecretSharingScheme,
     NoMasking,
+    PackedPaillierEncryption,
     PackedShamirSharing,
     Signature,
     SigningKey,

@@ -16,8 +16,11 @@ from .helpers import B32, B64, Binary, TaggedEnum
 # Ciphertexts, keys, signatures (crypto.rs:8-39)
 
 class Encryption(TaggedEnum):
-    """A ciphertext. ``Sodium`` = Curve25519+XSalsa20+Poly1305 sealed box."""
-    VARIANTS = {"Sodium": Binary}
+    """A ciphertext. ``Sodium`` = Curve25519+XSalsa20+Poly1305 sealed box;
+    ``PackedPaillier`` = length-framed homomorphic ciphertext batch (the
+    reference declares this variant but ships it disabled,
+    crypto.rs:164-174)."""
+    VARIANTS = {"Sodium": Binary, "PackedPaillier": Binary}
 
     @classmethod
     def sodium(cls, data: bytes) -> "Encryption":
@@ -25,8 +28,9 @@ class Encryption(TaggedEnum):
 
 
 class EncryptionKey(TaggedEnum):
-    """A public encryption key (32-byte Curve25519)."""
-    VARIANTS = {"Sodium": B32}
+    """A public encryption key: 32-byte Curve25519, or a big-endian
+    Paillier modulus n."""
+    VARIANTS = {"Sodium": B32, "PackedPaillier": Binary}
 
 
 class Signature(TaggedEnum):
@@ -262,6 +266,14 @@ class AdditiveEncryptionScheme:
     def from_obj(obj) -> "AdditiveEncryptionScheme":
         if obj == "Sodium":
             return SodiumEncryption()
+        if isinstance(obj, dict) and set(obj) == {"PackedPaillier"}:
+            p = obj["PackedPaillier"]
+            return PackedPaillierEncryption(
+                component_count=p["component_count"],
+                component_bitsize=p["component_bitsize"],
+                max_value_bitsize=p["max_value_bitsize"],
+                min_modulus_bitsize=p["min_modulus_bitsize"],
+            )
         raise ValueError(f"unknown encryption scheme {obj!r}")
 
     def __eq__(self, other):
@@ -281,3 +293,61 @@ class SodiumEncryption(AdditiveEncryptionScheme):
 
     def to_obj(self):
         return "Sodium"
+
+
+class PackedPaillierEncryption(AdditiveEncryptionScheme):
+    """Packed Paillier: additively homomorphic ciphertexts.
+
+    Parameter semantics follow the reference's (disabled) declaration,
+    crypto.rs:164-174: ``component_count`` values are packed per plaintext in
+    ``component_bitsize``-bit windows; fresh values must fit
+    ``max_value_bitsize`` bits, so up to ``2^(component_bitsize -
+    max_value_bitsize)`` ciphertexts can be summed homomorphically before a
+    component overflows its window; ``min_modulus_bitsize`` floors the key
+    size n (and component_count * component_bitsize must fit under it).
+    ``batch_size()`` is ``component_count``, matching crypto.rs:181-186.
+
+    Sizing note: in the *recipient* slot under ChaCha masking the encrypted
+    "mask" vector carries 32-bit seed words (chacha.rs:49-53 convention), so
+    that slot needs ``max_value_bitsize >= 32``; the committee slot only
+    carries field elements ``< modulus``.
+    """
+
+    def __init__(self, component_count: int, component_bitsize: int,
+                 max_value_bitsize: int, min_modulus_bitsize: int):
+        if max_value_bitsize > component_bitsize:
+            raise ValueError("max_value_bitsize exceeds the component window")
+        if component_bitsize > 63:
+            raise ValueError("component window exceeds the int64 share range")
+        if component_count * component_bitsize >= min_modulus_bitsize:
+            raise ValueError("packed plaintext does not fit under the modulus floor")
+        self.component_count = component_count
+        self.component_bitsize = component_bitsize
+        self.max_value_bitsize = max_value_bitsize
+        self.min_modulus_bitsize = min_modulus_bitsize
+
+    @property
+    def batch_size(self) -> int:  # type: ignore[override]
+        return self.component_count
+
+    @property
+    def additive_capacity(self) -> int:
+        """How many fresh ciphertexts may be summed without window overflow."""
+        return 1 << (self.component_bitsize - self.max_value_bitsize)
+
+    def to_obj(self):
+        return {
+            "PackedPaillier": {
+                "component_count": self.component_count,
+                "component_bitsize": self.component_bitsize,
+                "max_value_bitsize": self.max_value_bitsize,
+                "min_modulus_bitsize": self.min_modulus_bitsize,
+            }
+        }
+
+    def __repr__(self):
+        return (
+            f"PackedPaillierEncryption({self.component_count}, "
+            f"{self.component_bitsize}, {self.max_value_bitsize}, "
+            f"{self.min_modulus_bitsize})"
+        )
