@@ -116,6 +116,8 @@ def bench_readme_walkthrough():
         "metric": "full protocol round latency (3 participants, 3 clerks, dim 10)",
         "value": round(elapsed, 4),
         "unit": "seconds",
+        "note": "phone-sized rounds run the host/NumPy scheme path by design "
+                "(SDA_HOST_PATH_MAX), so this latency is device-independent",
         "elements_per_sec": round(participants * dim / elapsed, 1),
         "phases": {k: round(v["total_s"], 4) for k, v in phase_report().items()},
     }
@@ -365,8 +367,13 @@ def main():
         merged[r.get("config")] = r
     ordered = [merged[n] for n in CONFIGS if n in merged]
     ordered += [r for c, r in merged.items() if c not in CONFIGS]
+    # the header records where the MERGED results ran, not just this run —
+    # a partial CPU refresh must not relabel surviving TPU records
+    platforms = sorted({r.get("platform") for r in ordered if r.get("platform")})
+    header = dict(meta, last_run_platform=meta["platform"])
+    header["platform"] = platforms[0] if len(platforms) == 1 else platforms
     with open(out_path, "w") as f:
-        json.dump({"suite": meta, "results": ordered}, f, indent=2)
+        json.dump({"suite": header, "results": ordered}, f, indent=2)
 
 
 if __name__ == "__main__":

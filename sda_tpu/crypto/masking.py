@@ -29,7 +29,7 @@ from ..protocol import (
     NoMasking,
 )
 from . import rand
-from .sharing import mod_combine
+from .sharing import _small, mod_combine
 
 
 class SecretMasker:
@@ -69,6 +69,8 @@ class FullMasker(SecretMasker, MaskCombiner, SecretUnmasker):
     def mask(self, secrets):
         arr = np.asarray(secrets, dtype=np.int64)
         masks = rand.uniform(arr.shape, self.modulus)
+        if _small(arr.size):
+            return masks, (arr + masks) % self.modulus
         masked = np.asarray(
             fields.modadd(jnp.asarray(arr), jnp.asarray(masks), self.modulus)
         )
@@ -78,12 +80,12 @@ class FullMasker(SecretMasker, MaskCombiner, SecretUnmasker):
         return mod_combine(masks, self.modulus)
 
     def unmask(self, mask, masked):
+        masked = np.asarray(masked, dtype=np.int64)
+        mask = np.asarray(mask, dtype=np.int64)
+        if _small(masked.size):
+            return (masked - mask) % self.modulus
         return np.asarray(
-            fields.modsub(
-                jnp.asarray(np.asarray(masked, dtype=np.int64)),
-                jnp.asarray(np.asarray(mask, dtype=np.int64)),
-                self.modulus,
-            )
+            fields.modsub(jnp.asarray(masked), jnp.asarray(mask), self.modulus)
         )
 
 

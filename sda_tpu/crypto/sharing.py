@@ -16,13 +16,27 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import fields
-from ..fields import numtheory
+from ..fields import numtheory, oracle
 from ..protocol import (
     AdditiveSharing,
     LinearSecretSharingScheme,
     PackedShamirSharing,
 )
 from . import rand
+
+import os
+
+#: Below this much output work (elements), run the exact host/NumPy oracle
+#: path instead of dispatching to the device: a phone-sized vector (the
+#: reference's design point, README.md:8-11) costs microseconds on host but
+#: seconds of XLA compile + tunnel RTT per fresh shape on the accelerator.
+#: Both paths are bit-identical given identical randomness (tests assert
+#: device == oracle), so the dispatch is purely a latency decision.
+HOST_PATH_MAX = int(os.environ.get("SDA_HOST_PATH_MAX", 1 << 16))
+
+
+def _small(total_elements: int) -> bool:
+    return total_elements <= HOST_PATH_MAX
 
 
 def mod_combine(vectors: Sequence[np.ndarray], modulus: int) -> np.ndarray:
@@ -31,7 +45,10 @@ def mod_combine(vectors: Sequence[np.ndarray], modulus: int) -> np.ndarray:
     vecs = [np.asarray(v, dtype=np.int64) for v in vectors]
     if not vecs:
         return np.zeros(0, dtype=np.int64)
-    return np.asarray(fields.combine(jnp.asarray(np.stack(vecs)), modulus=modulus))
+    stacked = np.stack(vecs)
+    if _small(stacked.size):
+        return oracle.combine(stacked, modulus)
+    return np.asarray(fields.combine(jnp.asarray(stacked), modulus=modulus))
 
 
 class ShareGenerator:
@@ -61,6 +78,10 @@ class AdditiveShareGenerator(ShareGenerator):
     def generate(self, secrets):
         arr = np.asarray(secrets, dtype=np.int64)
         draws = rand.uniform((self.scheme.share_count - 1, arr.shape[-1]), self.scheme.modulus)
+        if _small(self.scheme.share_count * arr.shape[-1]):
+            return list(oracle.additive_share_from_randomness(
+                arr, draws, modulus=self.scheme.modulus
+            ))
         shares = fields.additive_share_from_randomness(
             jnp.asarray(arr), jnp.asarray(draws), modulus=self.scheme.modulus
         )
@@ -78,16 +99,26 @@ class AdditiveReconstructor(SecretReconstructor):
 class PackedShamirShareGenerator(ShareGenerator):
     def __init__(self, scheme: PackedShamirSharing):
         self.scheme = scheme
-        self._M = jnp.asarray(numtheory.packed_share_matrix(
-            scheme.secret_count, scheme.share_count, scheme.privacy_threshold,
-            scheme.prime_modulus, scheme.omega_secrets, scheme.omega_shares,
-        ))
+        self._M_device = None
+
+    @property
+    def _M(self):
+        # built lazily so host-path-only use never touches the device
+        if self._M_device is None:
+            s = self.scheme
+            self._M_device = jnp.asarray(numtheory.packed_share_matrix(
+                s.secret_count, s.share_count, s.privacy_threshold,
+                s.prime_modulus, s.omega_secrets, s.omega_shares,
+            ))
+        return self._M_device
 
     def generate(self, secrets):
         s = self.scheme
         arr = np.asarray(secrets, dtype=np.int64)
         B = -(-arr.shape[-1] // s.secret_count)
         randomness = rand.uniform((s.privacy_threshold, B), s.prime_modulus)
+        if _small(s.share_count * B):
+            return list(oracle.packed_share_from_randomness(arr, randomness, s))
         shares = fields.packed_share_from_randomness(
             jnp.asarray(arr), jnp.asarray(randomness), self._M,
             prime=s.prime_modulus, secret_count=s.secret_count,
@@ -116,13 +147,15 @@ class PackedShamirReconstructor(SecretReconstructor):
             )
         indexed_shares = list(indexed_shares)[:r]
         indices = tuple(int(i) for (i, _) in indexed_shares)
+        stacked_np = np.stack([np.asarray(v, dtype=np.int64) for (_, v) in indexed_shares])
+        if _small(stacked_np.size):
+            return oracle.packed_reconstruct(indices, stacked_np, s, self.dimension)
         L = jnp.asarray(numtheory.packed_reconstruct_matrix(
             s.secret_count, s.share_count, s.privacy_threshold,
             s.prime_modulus, s.omega_secrets, s.omega_shares, indices,
         ))
-        stacked = jnp.asarray(np.stack([np.asarray(v, dtype=np.int64) for (_, v) in indexed_shares]))
         return np.asarray(fields.packed_reconstruct(
-            stacked, L, prime=s.prime_modulus, dimension=self.dimension
+            jnp.asarray(stacked_np), L, prime=s.prime_modulus, dimension=self.dimension
         ))
 
 

@@ -221,3 +221,58 @@ def test_crypto_module_with_file_keystore_encrypt(tmp_path):
     ct = crypto.new_share_encryptor(keypair.ek, SodiumEncryption()).encrypt([1, 2, 3])
     out = crypto.new_share_decryptor(key_id, SodiumEncryption()).decrypt(ct)
     np.testing.assert_array_equal(out, [1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# small-work host dispatch (phone-sized vectors skip the device entirely)
+
+def test_small_work_host_path_is_exact_and_device_free(monkeypatch):
+    """Phone-sized rounds must not pay XLA compile/dispatch latency: below
+    HOST_PATH_MAX the scheme ops run on the NumPy oracle path, bit-identical
+    to the device path given the same randomness."""
+    from sda_tpu import fields
+    from sda_tpu.crypto import rand, sharing
+    from sda_tpu.crypto.masking import FullMasker
+    from sda_tpu.crypto.sharing import (
+        AdditiveShareGenerator,
+        PackedShamirReconstructor,
+        PackedShamirShareGenerator,
+        ShareCombiner,
+    )
+    from sda_tpu.protocol import AdditiveSharing, PackedShamirSharing
+
+    pss = PackedShamirSharing(3, 8, 4, 433, 354, 150)
+    adds = AdditiveSharing(share_count=3, modulus=433)
+    rng = np.random.default_rng(5)
+    secrets = rng.integers(0, 433, size=10)
+
+    fixed = rand.uniform((pss.privacy_threshold, 4), 433)
+    monkeypatch.setattr(rand, "uniform", lambda shape, m, mode=None: fixed.copy())
+
+    device_before = fields.packed_reconstruct._cache_size()
+    host_shares = PackedShamirShareGenerator(pss).generate(secrets)
+    monkeypatch.setattr(sharing, "HOST_PATH_MAX", 0)
+    # re-run the SAME randomness on the device path
+    device_shares = PackedShamirShareGenerator(pss).generate(secrets)
+    for h, d in zip(host_shares, device_shares):
+        np.testing.assert_array_equal(h, d)
+
+    monkeypatch.setattr(sharing, "HOST_PATH_MAX", 1 << 16)
+    recon = PackedShamirReconstructor(pss, dimension=10)
+    got = recon.reconstruct(list(enumerate(host_shares))[: pss.reconstruction_threshold + 1])
+    np.testing.assert_array_equal(got, secrets)
+    # reconstruction of this tiny round never compiled a device kernel
+    assert fields.packed_reconstruct._cache_size() == device_before
+
+    combined = ShareCombiner(433).combine([s % 433 for s in host_shares[:3]])
+    np.testing.assert_array_equal(
+        combined, np.stack(host_shares[:3]).sum(axis=0) % 433
+    )
+
+    masker = FullMasker(433)
+    monkeypatch.setattr(
+        rand, "uniform", lambda shape, m, mode=None: np.full(shape, 7, dtype=np.int64)
+    )
+    mask, masked = masker.mask(secrets)
+    np.testing.assert_array_equal(masked, (secrets + 7) % 433)
+    np.testing.assert_array_equal(masker.unmask(mask, masked), secrets)
