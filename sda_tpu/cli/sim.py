@@ -41,7 +41,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    from ..utils import configure_logging, phase_report, reset_phase_report
+    from ..utils import (
+        configure_logging,
+        counter_report,
+        phase_report,
+        reset_counters,
+        reset_phase_report,
+    )
 
     configure_logging(args.verbose)
 
@@ -65,6 +71,7 @@ def main(argv=None) -> int:
     inputs = rng.integers(0, 1 << 20, size=(args.participants, dim), dtype=np.int64)
 
     reset_phase_report()
+    reset_counters()
     key = jax.random.PRNGKey(0)
     if args.streaming:
         agg = StreamingAggregator(
@@ -101,6 +108,9 @@ def main(argv=None) -> int:
     if phases:
         result["phases_s"] = {name: round(stat["total_s"], 4)
                               for name, stat in phases.items()}
+    counters = counter_report()
+    if counters:
+        result["counters"] = counters
     print(json.dumps(result))
     return 0
 

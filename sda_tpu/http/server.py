@@ -65,6 +65,7 @@ from ..protocol import (
     signed_encryption_key_from_obj,
 )
 from ..server import SdaServerService, auth_token
+from ..utils import metrics
 
 log = logging.getLogger(__name__)
 
@@ -128,6 +129,8 @@ class _Handler(BaseHTTPRequestHandler):
         if counts is not None:
             with self.server.stats_lock:  # type: ignore[attr-defined]
                 counts[status] = counts.get(status, 0) + 1
+        metrics.count("http.request")
+        metrics.count(f"http.status.{status}")
 
     def _reply_option(self, obj):
         if obj is None:

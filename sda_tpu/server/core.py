@@ -39,6 +39,7 @@ from ..protocol import (
     SnapshotResult,
     SnapshotStatus,
 )
+from ..utils import metrics
 from . import snapshot as snapshot_mod
 from .stores import (
     AgentsStore,
@@ -124,6 +125,7 @@ class SdaServer:
     # -- participation -----------------------------------------------------
     def create_participation(self, participation: Participation) -> None:
         self.aggregation_store.create_participation(participation)
+        metrics.count("server.participation.created")
 
     # -- status / snapshots ------------------------------------------------
     def get_aggregation_status(
@@ -151,10 +153,13 @@ class SdaServer:
 
     def create_snapshot(self, snapshot: Snapshot) -> None:
         snapshot_mod.snapshot(self, snapshot)
+        metrics.count("server.snapshot.created")
 
     # -- clerking ----------------------------------------------------------
     def poll_clerking_job(self, clerk: AgentId) -> Optional[ClerkingJob]:
-        return self.clerking_job_store.poll_clerking_job(clerk)
+        job = self.clerking_job_store.poll_clerking_job(clerk)
+        metrics.count("server.job.polled" if job else "server.job.poll_empty")
+        return job
 
     def get_clerking_job(
         self, clerk: AgentId, job: ClerkingJobId
@@ -163,6 +168,7 @@ class SdaServer:
 
     def create_clerking_result(self, result: ClerkingResult) -> None:
         self.clerking_job_store.create_clerking_result(result)
+        metrics.count("server.clerking_result.created")
 
     def get_snapshot_result(
         self, aggregation: AggregationId, snapshot: SnapshotId

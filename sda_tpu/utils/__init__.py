@@ -1,4 +1,4 @@
-"""Cross-cutting utilities: phase timing, profiler hooks, logging setup."""
+"""Cross-cutting utilities: phase timing, counters, profiler hooks, logging."""
 
 from .timing import (
     PhaseStat,
@@ -7,13 +7,17 @@ from .timing import (
     reset_phase_report,
     timed_phase,
 )
+from .metrics import count, counter_report, reset_counters
 from .logsetup import configure_logging
 
 __all__ = [
     "PhaseStat",
     "configure_logging",
+    "count",
+    "counter_report",
     "phase_report",
     "profile_trace",
+    "reset_counters",
     "reset_phase_report",
     "timed_phase",
 ]

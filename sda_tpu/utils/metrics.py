@@ -1,0 +1,37 @@
+"""Process-global event counters — the metrics floor the reference lacks.
+
+The reference has structured logging but zero metrics counters anywhere
+(SURVEY.md §5.5: "No metrics counters"). This registry closes that gap the
+same way ``timing.py`` does for spans: named monotonic counters with a
+process-global, thread-safe store, incremented at the protocol choke points
+(server ops, HTTP requests) and read back by benchmarks, the sim CLI, and
+tests. Cost per hit is one lock + dict update — noise next to any I/O.
+
+Naming convention: dotted paths, ``server.participation.created``,
+``http.request``, ``http.status.200``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}
+
+
+def count(name: str, n: int = 1) -> None:
+    """Add ``n`` to the named counter (creating it at zero)."""
+    with _lock:
+        _counts[name] = _counts.get(name, 0) + n
+
+
+def counter_report(prefix: str = "") -> Dict[str, int]:
+    """Snapshot of all counters (optionally filtered by name prefix)."""
+    with _lock:
+        return {k: v for k, v in sorted(_counts.items()) if k.startswith(prefix)}
+
+
+def reset_counters() -> None:
+    with _lock:
+        _counts.clear()

@@ -20,7 +20,27 @@ from sda_tpu.protocol import (
     SodiumEncryption,
 )
 from sda_tpu.server import new_memory_server
-from sda_tpu.utils import configure_logging, phase_report, reset_phase_report, timed_phase
+from sda_tpu.utils import (
+    configure_logging,
+    count,
+    counter_report,
+    phase_report,
+    reset_counters,
+    reset_phase_report,
+    timed_phase,
+)
+
+
+def test_counter_registry_basics():
+    reset_counters()
+    count("unit.a")
+    count("unit.a", 2)
+    count("unit.b")
+    count("other.c")
+    assert counter_report()["unit.a"] == 3
+    assert counter_report("unit.") == {"unit.a": 3, "unit.b": 1}
+    reset_counters()
+    assert counter_report() == {}
 
 
 def test_timed_phase_accumulates():
@@ -46,6 +66,7 @@ def test_timed_phase_records_on_exception():
 @pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
 def test_full_round_populates_all_protocol_phases():
     reset_phase_report()
+    reset_counters()
     service = new_memory_server()
 
     def new_client():
@@ -95,6 +116,13 @@ def test_full_round_populates_all_protocol_phases():
     assert report["participant.share"]["count"] == 2  # one per participant
     assert report["clerk.combine"]["count"] == 3      # one per committee clerk
 
+    counters = counter_report("server.")
+    assert counters["server.participation.created"] == 2
+    assert counters["server.snapshot.created"] == 1
+    assert counters["server.clerking_result.created"] == 3
+    assert counters["server.job.polled"] == 3
+    assert counters["server.job.poll_empty"] >= 1  # run_chores drains to empty
+
 
 def test_http_request_status_logging_and_counters():
     """Per-request status lines + status counters (reference analog:
@@ -111,6 +139,7 @@ def test_http_request_status_logging_and_counters():
     http_log.addHandler(handler)
     old_level = http_log.level
     http_log.setLevel(logging.INFO)
+    reset_counters()
     srv = SdaHttpServer(new_memory_server(), bind="127.0.0.1:0").start_background()
     try:
         urllib.request.urlopen(srv.address + "/v1/ping").read()
@@ -121,6 +150,10 @@ def test_http_request_status_logging_and_counters():
         counts = srv.status_counts
         assert counts.get(200) == 1
         assert counts.get(401) == 1  # unknown route without auth -> 401
+        globals_ = counter_report("http.")
+        assert globals_["http.request"] == 2
+        assert globals_["http.status.200"] == 1
+        assert globals_["http.status.401"] == 1
         lines = buf.getvalue().strip().splitlines()
         assert any("GET /v1/ping -> 200" in l for l in lines)
         assert any("-> 401" in l for l in lines)
