@@ -1,6 +1,12 @@
 """TPU-native simulated-pod execution over a device mesh."""
 
-from .simpod import SimulatedPod, default_mesh_shape, make_mesh, single_chip_round
+from .simpod import (
+    SimulatedPod,
+    default_mesh_shape,
+    make_mesh,
+    make_multislice_mesh,
+    single_chip_round,
+)
 from .streaming import (
     StreamedPod,
     StreamingAggregator,

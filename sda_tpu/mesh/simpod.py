@@ -115,6 +115,31 @@ def default_mesh_shape(n_devices: int, share_count: int) -> Tuple[int, int]:
     return p_shards, n_devices // p_shards
 
 
+def make_multislice_mesh(
+    n_slices: int, p_per_slice: int, d_shards: int, devices=None
+) -> Mesh:
+    """A ('p', 'd') mesh whose participant axis spans multiple slices.
+
+    Multi-slice layout rule (the DCN story, SURVEY §5.8): the ``d`` axis —
+    whose collectives run every round-stage — must stay *inside* a slice on
+    ICI, so ``d`` is the minor device axis within each slice's contiguous
+    device block; the participant axis is slice-major, so only the
+    all-reduce fold over ``p`` crosses the slice boundary, and XLA phases
+    that reduction into an intra-slice (ICI) step plus one inter-slice
+    (DCN) step of size ``n_slices``. Device order: devices[i] blocks of
+    ``p_per_slice * d_shards`` per slice, exactly the contiguous-slice
+    ordering ``jax.devices()`` returns on real multislice TPU deployments.
+    The returned mesh has plain ('p', 'd') axes, so every pod/streaming
+    code path works unchanged on it.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = n_slices * p_per_slice * d_shards
+    if devices.size < n:
+        raise ValueError(f"need {n} devices, have {devices.size}")
+    block = devices.reshape(-1)[:n].reshape(n_slices, p_per_slice, d_shards)
+    return Mesh(block.reshape(n_slices * p_per_slice, d_shards), ("p", "d"))
+
+
 # ---------------------------------------------------------------------------
 # Round stages, shared by the SPMD pod body and the single-chip round.
 # Every function takes canonical residues in the FieldOps working dtype.
