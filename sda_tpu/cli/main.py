@@ -23,6 +23,7 @@ from ..protocol import (
     EncryptionKeyId,
     FullMasking,
     NoMasking,
+    PackedPaillierEncryption,
     PackedShamirSharing,
     SodiumEncryption,
 )
@@ -46,7 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
     agent.add_parser("create")
     agent.add_parser("show")
     keys = agent.add_parser("keys").add_subparsers(dest="keys_command", required=True)
-    keys.add_parser("create")
+    keys_create = keys.add_parser("create")
+    keys_create.add_argument("--encryption", choices=["sodium", "paillier"],
+                             default="sodium")
+    keys_create.add_argument("--paillier-modulus-bits", type=int, default=2048)
 
     clerk = sub.add_parser("clerk")
     clerk.add_argument("--once", action="store_true", help="drain the queue once and exit")
@@ -62,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
     create.add_argument("--seed-bits", type=int, default=128)
     create.add_argument("--sharing", choices=["add", "shamir"], default="add")
     create.add_argument("--shares", type=int, default=3, help="committee size")
+    create.add_argument("--encryption", choices=["sodium", "paillier"],
+                        default="sodium",
+                        help="share-transport encryption for both slots "
+                             "(paillier = additively homomorphic)")
+    create.add_argument("--paillier-modulus-bits", type=int, default=2048)
     create.add_argument("--secrets-per-batch", type=int, default=3,
                         help="packed secrets per polynomial (shamir)")
     lst = agg.add_parser("list")
@@ -122,7 +131,14 @@ def main(argv=None) -> int:
             return 0
         if args.agent_command == "keys":
             client.upload_agent()  # idempotent; key upload needs the agent
-            key_id = client.new_encryption_key()
+            key_scheme = None
+            if args.encryption == "paillier":
+                # only min_modulus_bitsize matters for key material; window
+                # parameters are carried per-aggregation, not per-key
+                key_scheme = PackedPaillierEncryption(
+                    1, 32, 32, args.paillier_modulus_bits
+                )
+            key_id = client.new_encryption_key(key_scheme)
             client.upload_encryption_key(key_id)
             store.put(f"keymeta-{key_id}", {"id": str(key_id)})
             store.put_alias(KEY_ALIAS, f"keymeta-{key_id}")
@@ -181,6 +197,30 @@ def main(argv=None) -> int:
                               "(--secrets-per-batch/--shares affect the "
                               "generator)", file=sys.stderr)
                 sharing = PackedShamirSharing(k, args.shares, t, p, w2, w3)
+            if args.encryption == "paillier":
+                # windows must fit the widest values each slot carries:
+                # shares/partial-sums live mod the SHARING modulus (the NTT
+                # prime for shamir), and ChaCha "masks" are 32-bit seed words
+                share_bits = (
+                    sharing.prime_modulus if args.sharing == "shamir"
+                    else sharing.modulus
+                ).bit_length()
+                value_bits = max(share_bits, 32 if args.mask == "chacha" else 0)
+                window = value_bits + 16  # capacity 2^16 homomorphic summands
+                count = max(1, (args.paillier_modulus_bits - 1) // window)
+                try:
+                    encryption_scheme = PackedPaillierEncryption(
+                        min(count, 64), window, value_bits,
+                        args.paillier_modulus_bits,
+                    )
+                except ValueError as e:
+                    print(f"error: --paillier-modulus-bits "
+                          f"{args.paillier_modulus_bits} cannot hold even one "
+                          f"{window}-bit component window ({e}); use a larger "
+                          f"key size", file=sys.stderr)
+                    return 1
+            else:
+                encryption_scheme = SodiumEncryption()
             aggregation = Aggregation(
                 id=AggregationId.random(),
                 title=args.title,
@@ -190,8 +230,8 @@ def main(argv=None) -> int:
                 recipient_key=_primary_key(client, store),
                 masking_scheme=masking,
                 committee_sharing_scheme=sharing,
-                recipient_encryption_scheme=SodiumEncryption(),
-                committee_encryption_scheme=SodiumEncryption(),
+                recipient_encryption_scheme=encryption_scheme,
+                committee_encryption_scheme=encryption_scheme,
             )
             client.upload_aggregation(aggregation)
             print(str(aggregation.id))

@@ -92,3 +92,34 @@ def test_cli_shamir_aggregation(httpd, tmp_path, capsys):
         sda(f"clerk-{i}", "clerk", "--once")
     sda("recipient", "clerk", "--once")
     assert sda("recipient", "aggregations", "reveal", agg_id) == "2 4 6 8"
+
+
+def test_cli_paillier_aggregation(httpd, tmp_path, capsys):
+    """--encryption paillier: homomorphic-capable encryption in both slots,
+    Paillier keys via `keys create --encryption paillier` (512-bit keys to
+    keep the test fast; default is 2048)."""
+    url = httpd.address
+
+    def sda(identity, *args):
+        rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity), *args])
+        assert rc == 0
+        return capsys.readouterr().out.strip()
+
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "agent", "create")
+        sda(who, "agent", "keys", "create",
+            "--encryption", "paillier", "--paillier-modulus-bits", "512")
+
+    agg_id = sda(
+        "recipient", "aggregations", "create", "paillier-run",
+        "--dimension", "4", "--modulus", "433", "--shares", "3",
+        "--mask", "full", "--encryption", "paillier",
+        "--paillier-modulus-bits", "512",
+    )
+    sda("recipient", "aggregations", "begin", agg_id)
+    sda("p", "participate", agg_id, "1", "2", "3", "4")
+    sda("q", "participate", agg_id, "10", "20", "30", "40")
+    sda("recipient", "aggregations", "end", agg_id)
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "clerk", "--once")
+    assert sda("recipient", "aggregations", "reveal", agg_id) == "11 22 33 44"
