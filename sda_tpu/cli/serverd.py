@@ -20,6 +20,9 @@ def build_parser() -> argparse.ArgumentParser:
     backend.add_argument("--mongo", metavar="URI", help="MongoDB URI (needs pymongo)")
     parser.add_argument("--mongo-dbname", default="sda")
     backend.add_argument("--memory", action="store_true", help="in-memory store")
+    parser.add_argument("--premix-paillier", action="store_true",
+                        help="homomorphically combine clerk columns at "
+                             "snapshot time for PackedPaillier aggregations")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     sub = parser.add_subparsers(dest="command", required=True)
     httpd = sub.add_parser("httpd")
@@ -48,6 +51,9 @@ def main(argv=None) -> int:
         service = new_mongo_server(args.mongo, args.mongo_dbname)
     else:
         service = new_jsonfs_server(args.jfs or "./sdad-store")
+
+    if args.premix_paillier:
+        service.server.premix_paillier = True
 
     server = SdaHttpServer(service, bind=args.bind)
     print(f"sdad listening on {server.address}", flush=True)

@@ -64,6 +64,10 @@ class SdaServer:
         self.auth_tokens_store = auth_tokens_store
         self.aggregation_store = aggregation_store
         self.clerking_job_store = clerking_job_store
+        #: opt-in: homomorphically combine each clerk's ciphertext column at
+        #: snapshot time when the committee scheme is PackedPaillier
+        #: (snapshot.py premixing) — clerk downloads shrink ~N x
+        self.premix_paillier = False
 
     # -- health ------------------------------------------------------------
     def ping(self) -> Pong:

@@ -4,17 +4,70 @@ Reference: server/src/snapshot.rs:4-47. Creating a snapshot (1) freezes the
 current participation set, (2) transposes participations x clerks into one
 ClerkingJob per committee member, (3) records the snapshot, and (4) collects
 the recipient-mask encryptions if the aggregation masks. All heavy lifting
-is data movement; the field math happens at the clerks.
+is data movement; the field math happens at the clerks — EXCEPT under
+Paillier premixing (below), where the broker also multiplies ciphertexts.
+
+Premixing: when the committee encryption scheme is PackedPaillier and the
+server opts in (``SdaServer.premix_paillier``), each clerk's column of
+participation ciphertext batches is homomorphically combined *on the
+server* before enqueueing — the untrusted broker compresses every clerk's
+download from N batches to ceil(N / additive_capacity) without learning
+anything (ciphertext products reveal nothing new), and the clerk-side flow
+is unchanged: it decrypts integer share sums and its modular combine
+reduces them. This is the payoff the reference's commented-out
+PackedPaillier declaration (protocol/src/crypto.rs:164-174) was pointing
+at; Sodium aggregations are untouched since sealed boxes don't compose.
 """
 
 from __future__ import annotations
 
 import logging
 
-from ..protocol import ClerkingJob, ClerkingJobId, NotFound, Snapshot
-from ..utils import timed_phase
+from ..protocol import (
+    ClerkingJob,
+    ClerkingJobId,
+    NotFound,
+    PackedPaillierEncryption,
+    Snapshot,
+)
+from ..utils import metrics, timed_phase
 
 log = logging.getLogger(__name__)
+
+
+def _premix_columns(server, aggregation, committee, columns):
+    """Per-clerk homomorphic combine of participation ciphertext columns."""
+    from ..crypto.encryption import paillier_combine
+
+    scheme = aggregation.committee_encryption_scheme
+    cap = scheme.additive_capacity
+    mixed = []
+    for (clerk_id, key_id), column in zip(committee.clerks_and_keys, columns):
+        signed_key = server.get_encryption_key(key_id)
+        if signed_key is None:
+            raise NotFound("lost clerk encryption key")
+        ek = signed_key.body.body
+        try:
+            combined = [
+                paillier_combine(ek, scheme, column[i : i + cap])
+                for i in range(0, len(column), cap)
+            ]
+        except ValueError as e:
+            # participant uploads are untrusted: a forged/malformed batch
+            # must not wedge snapshot creation for everyone — enqueue the
+            # column unmixed and let the clerk hit the bad batch itself,
+            # exactly as it would without premixing
+            log.warning(
+                "premix skipped for clerk %s (malformed participation "
+                "ciphertext: %s); enqueueing column unmixed", clerk_id, e
+            )
+            metrics.count("server.premix.skipped_malformed")
+            mixed.append(column)
+            continue
+        metrics.count("server.premix.inputs", len(column))
+        metrics.count("server.premix.outputs", len(combined))
+        mixed.append(combined)
+    return mixed
 
 
 def snapshot(server, snap: Snapshot) -> None:
@@ -34,6 +87,17 @@ def snapshot(server, snap: Snapshot) -> None:
         columns = server.aggregation_store.iter_snapshot_clerk_jobs_data(
             snap.aggregation, snap.id, len(committee.clerks_and_keys)
         )
+
+    if (
+        getattr(server, "premix_paillier", False)
+        and isinstance(
+            aggregation.committee_encryption_scheme, PackedPaillierEncryption
+        )
+        and any(columns)
+    ):
+        log.debug("snapshot %s: premixing clerk columns homomorphically", snap.id)
+        with timed_phase("server.premix"):
+            columns = _premix_columns(server, aggregation, committee, columns)
 
     log.debug("snapshot %s: enqueueing %d clerking jobs", snap.id, len(columns))
     with timed_phase("server.enqueue_jobs"):

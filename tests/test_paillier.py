@@ -364,3 +364,150 @@ def test_full_loop_with_paillier_encryption(sharing, masking, recipient_scheme):
 
     output = recipient.reveal_aggregation(aggregation.id)
     np.testing.assert_array_equal(output.positive().values, [2, 4, 6, 8])
+
+
+@pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+@pytest.mark.parametrize("capacity_bits", [16, 1], ids=["one-batch", "chunked"])
+def test_server_premixes_paillier_clerk_columns(capacity_bits):
+    """Opt-in broker premixing: with PackedPaillier committee encryption the
+    snapshot combines each clerk's ciphertext column homomorphically, so a
+    clerk downloads ceil(N/capacity) batches instead of N — and the round
+    stays exact. capacity 2^1 forces the chunked path (5 participants ->
+    3 combined batches)."""
+    service = new_memory_server()
+    service.server.premix_paillier = True
+    scheme = PackedPaillierEncryption(3, 16 + capacity_bits, 16, 512)
+
+    def new_client():
+        keystore = MemoryKeystore()
+        agent = SdaClient.new_agent(keystore)
+        return SdaClient(agent, keystore, service)
+
+    recipient = new_client()
+    recipient_key = recipient.new_encryption_key(SCHEME)
+    recipient.upload_agent()
+    recipient.upload_encryption_key(recipient_key)
+    aggregation = Aggregation(
+        id=AggregationId.random(),
+        title="premix",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=recipient_key,
+        masking_scheme=FullMasking(433),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SCHEME,
+        committee_encryption_scheme=scheme,
+    )
+    recipient.upload_aggregation(aggregation)
+    clerks = [new_client() for _ in range(3)]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key(scheme))
+    recipient.begin_aggregation(aggregation.id)
+
+    n_participants = 5
+    rng = np.random.default_rng(11)
+    vectors = rng.integers(0, 433, size=(n_participants, 4))
+    for v in vectors:
+        participant = new_client()
+        participant.upload_agent()
+        participant.participate([int(x) for x in v], aggregation.id)
+    recipient.end_aggregation(aggregation.id)
+
+    # inspect the enqueued jobs BEFORE clerking: columns must be premixed
+    capacity = scheme.additive_capacity
+    expected_batches = -(-n_participants // capacity)
+    store = service.server.clerking_job_store
+    seen_jobs = 0
+    for clerk in clerks + [recipient]:
+        job = store.poll_clerking_job(clerk.agent.id)
+        if job is None:
+            continue
+        seen_jobs += 1
+        assert len(job.encryptions) == expected_batches, (
+            f"clerk column not premixed: {len(job.encryptions)} batches"
+        )
+    assert seen_jobs == 3
+
+    recipient.run_chores(-1)
+    for clerk in clerks:
+        clerk.run_chores(-1)
+    output = recipient.reveal_aggregation(aggregation.id)
+    np.testing.assert_array_equal(
+        output.positive().values, vectors.sum(axis=0) % 433
+    )
+
+
+@pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+def test_premix_flag_leaves_sodium_aggregations_untouched():
+    service = new_memory_server()
+    service.server.premix_paillier = True
+    # reuse the standard sodium full loop via the shared helper
+    import test_full_loop as fl
+
+    fl.check_full_aggregation(fl.agg_default(), service)
+
+
+@pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+def test_premix_survives_malformed_participation():
+    """Untrusted uploads can't wedge the snapshot: a forged ciphertext frame
+    makes the server skip premixing for the affected columns (enqueued
+    unmixed) instead of failing the recipient's end_aggregation."""
+    from sda_tpu.protocol import Binary, Encryption
+
+    service = new_memory_server()
+    service.server.premix_paillier = True
+
+    def new_client():
+        keystore = MemoryKeystore()
+        agent = SdaClient.new_agent(keystore)
+        return SdaClient(agent, keystore, service)
+
+    recipient = new_client()
+    recipient_key = recipient.new_encryption_key(SCHEME)
+    recipient.upload_agent()
+    recipient.upload_encryption_key(recipient_key)
+    aggregation = Aggregation(
+        id=AggregationId.random(),
+        title="premix-hostile",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=recipient_key,
+        masking_scheme=FullMasking(433),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SCHEME,
+        committee_encryption_scheme=SCHEME,
+    )
+    recipient.upload_aggregation(aggregation)
+    clerks = [new_client() for _ in range(3)]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key(SCHEME))
+    recipient.begin_aggregation(aggregation.id)
+
+    honest = new_client()
+    honest.upload_agent()
+    honest.participate([1, 2, 3, 4], aggregation.id)
+
+    # hostile participant: clone an honest participation shape but replace
+    # every clerk encryption with a frame claiming capacity summands
+    hostile = new_client()
+    hostile.upload_agent()
+    participation = hostile.new_participation([5, 6, 7, 8], aggregation.id)
+    forged = bytes([3, 0x7F]) + bytes(8)  # count=3, summands=127 -> huge varint ok
+    participation.clerk_encryptions = [
+        (cid, Encryption("PackedPaillier", Binary(forged)))
+        for (cid, _) in participation.clerk_encryptions
+    ]
+    service.create_participation(hostile.agent, participation)
+
+    # the snapshot must still succeed — columns fall back to unmixed
+    recipient.end_aggregation(aggregation.id)
+    store = service.server.clerking_job_store
+    job = store.poll_clerking_job(clerks[0].agent.id)
+    if job is None:
+        job = store.poll_clerking_job(recipient.agent.id)
+    assert job is not None
+    assert len(job.encryptions) == 2  # unmixed: one per participation
