@@ -68,7 +68,14 @@ def _run(platform: str, use_pallas: bool) -> dict:
     if use_pallas:
         from sda_tpu.fields.pallas_round import single_chip_round_pallas
 
-        fn = jax.jit(single_chip_round_pallas(scheme, FullMasking(p)))
+        # sweepable kernel knobs (hardware tuning): participants folded per
+        # matmul block, and the lane-dim tile width
+        p_block = int(os.environ.get("SDA_PALLAS_PBLOCK", 16))
+        tile_env = os.environ.get("SDA_PALLAS_TILE")
+        fn = jax.jit(single_chip_round_pallas(
+            scheme, FullMasking(p), p_block=p_block,
+            tile=int(tile_env) if tile_env else None,
+        ))
     else:
         fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
 

@@ -1,0 +1,87 @@
+"""Kernel-knob sweep for the fused Pallas round on real TPU hardware.
+
+Sweeps the two knobs that set the fused kernel's efficiency — ``p_block``
+(participants folded per matmul block; larger blocks amortize the share
+matmul further but grow VMEM pressure) and ``tile`` (lane-dim width;
+larger tiles amortize grid-step overhead) — on the flagship shape, using
+the same chained-dispatch marginal timing as bench.py so tunnel RTTs
+cancel. Prints one JSON line per point plus a best-point summary. Run:
+
+    SDA_BENCH_PLATFORM=tpu python benchmarks/pallas_sweep.py
+
+Env: SDA_SWEEP_PBLOCKS / SDA_SWEEP_TILES (comma-separated overrides),
+SDA_BENCH_PARTICIPANTS / SDA_BENCH_DIM for the shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sda_tpu.utils.backend import log, select_platform, use_platform  # noqa: E402
+
+
+def main() -> None:
+    platform = select_platform()
+    use_platform(platform)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sda_tpu.fields import numtheory
+    from sda_tpu.fields.pallas_round import single_chip_round_pallas
+    from sda_tpu.protocol import FullMasking, PackedShamirSharing
+    from sda_tpu.utils.benchtime import marginal_seconds
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        log("WARNING: sweeping on CPU — numbers are meaningless for tuning")
+
+    participants = int(os.environ.get("SDA_BENCH_PARTICIPANTS", 100))
+    dim = int(os.environ.get("SDA_BENCH_DIM", 999_999))
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    scheme = PackedShamirSharing(3, 8, t, p, w2, w3)
+
+    pblocks = [int(x) for x in os.environ.get(
+        "SDA_SWEEP_PBLOCKS", "8,16,32,64").split(",")]
+    tiles = [int(x) for x in os.environ.get(
+        "SDA_SWEEP_TILES", "1024,2048,4096").split(",")]
+
+    rng = np.random.default_rng(0)
+    inputs = jnp.asarray(
+        rng.integers(0, 1 << 20, size=(participants, dim), dtype=np.uint32)
+    )
+    key = jax.random.PRNGKey(0)
+    expected = np.asarray(inputs).sum(axis=0) % p
+
+    best = None
+    for p_block in pblocks:
+        for tile in tiles:
+            label = {"p_block": p_block, "tile": tile}
+            try:
+                fn = jax.jit(single_chip_round_pallas(
+                    scheme, FullMasking(p), p_block=p_block, tile=tile,
+                    interpret=dev.platform == "cpu",  # CPU: smoke-test only
+                ))
+                out = jax.device_get(fn(inputs, key))  # compile + exactness
+                assert np.array_equal(out, expected), "wrong aggregate"
+                per_round, timing = marginal_seconds(
+                    lambda i: fn(inputs, jax.random.fold_in(key, i)),
+                    target_seconds=float(os.environ.get("SDA_BENCH_SECONDS", 6)),
+                )
+                value = participants * dim / per_round
+                point = {**label, "elements_per_sec": round(value),
+                         "round_ms": round(per_round * 1e3, 3), **timing}
+                if best is None or value > best["elements_per_sec"]:
+                    best = point
+            except Exception as e:  # keep sweeping past bad points
+                point = {**label, "error": f"{type(e).__name__}: {e}"}
+            print(json.dumps(point), flush=True)
+    print(json.dumps({"best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

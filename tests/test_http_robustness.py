@@ -115,18 +115,18 @@ def test_resource_not_found_header_distinguishes_404s(srv, tmp_path):
     http = client.service  # SdaHttpClient
     with pytest.raises(NotFound):
         http._get(client.agent, "/v1/definitely/not/a/route")
+    user, token = http._auth(client.agent)  # the really-minted token
     req = urllib.request.Request(srv.address + "/v1/definitely/not/a/route")
     req.add_header(
         "Authorization",
-        "Basic "
-        + base64.b64encode(f"{client.agent.id}:irrelevant".encode()).decode(),
+        "Basic " + base64.b64encode(f"{user}:{token}".encode()).decode(),
     )
     try:
         urllib.request.urlopen(req, timeout=10)
         headers, code = {}, None
     except urllib.error.HTTPError as e:
         headers, code = dict(e.headers), e.code
-    assert code in (401, 404)  # bad token -> 401; good token -> 404
+    assert code == 404  # authenticated route-miss
     assert "X-Resource-Not-Found" not in headers
 
 
