@@ -1,5 +1,6 @@
 """TPU-native simulated-pod execution over a device mesh."""
 
+from . import multihost
 from .simpod import (
     SimulatedPod,
     default_mesh_shape,

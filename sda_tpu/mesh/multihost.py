@@ -1,0 +1,126 @@
+"""Multi-host execution: the DCN-scale story made runnable.
+
+The reference scales across machines with a REST broker; the pod modes
+replace that with XLA collectives over ICI (SURVEY §5.8). This module
+closes the remaining gap — *multi-controller* runs where each host owns a
+process-local slice of the participants and the collectives ride ICI
+within a host/slice and DCN across them:
+
+- ``initialize()`` wraps ``jax.distributed.initialize`` (call before any
+  jax backend touch; on TPU pods the arguments are auto-detected).
+- ``aggregate_process_local(pod, local_inputs)`` runs one full secure-
+  aggregation round where every process contributes its own participant
+  rows: inputs are assembled into a global array with
+  ``jax.make_array_from_process_local_data`` (no host ever materializes
+  the global input), the pod's SPMD round runs once, and every process
+  receives the full [d] aggregate.
+
+Pair the mesh with ``make_multislice_mesh(n_slices=process_count, ...)``
+so each process's devices form one contiguous slice block of the ``p``
+axis — then participant data never crosses hosts; only the clerk-combine
+reduction does (one DCN step, SURVEY §2.4's committee parallelism).
+
+Tested for real with two OS processes over gRPC on CPU meshes
+(tests/test_multihost.py) — the same code path multi-host TPU uses.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """``jax.distributed.initialize`` with explicit args (CPU/GPU fleets)
+    or auto-detection (TPU pods). Must run before any jax backend init.
+    On CPU fleets set the per-process device count via
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def aggregate_process_local(pod, local_inputs, key=None):
+    """One secure-aggregation round over process-local participant rows.
+
+    Every process passes a ``[P_local, d]`` block of the SAME shape (ragged
+    counts must be zero-padded by the caller first — zero rows aggregate as
+    zero with their masks cancelling). Returns the full [d] aggregate as
+    host numpy, identical on every process.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..crypto.core import fresh_prng_key
+    from ..utils import timed_phase
+
+    inputs = np.asarray(local_inputs)
+    if inputs.ndim != 2:
+        raise ValueError("local_inputs must be [P_local, d]")
+    nproc = jax.process_count()
+    P_local, d_total = inputs.shape
+
+    # all processes must agree on the global shape; cheapest agreement is
+    # requiring a common local row count (ragged blocks would silently
+    # misalign the participant axis)
+    shapes = multihost_utils.process_allgather(
+        jnp.asarray([P_local, d_total], dtype=jnp.int32)
+    ).reshape(nproc, 2)
+    if not (shapes == shapes[0]).all():
+        raise ValueError(
+            f"process-local input shapes disagree: {shapes.tolist()}"
+        )
+
+    P_global = P_local * nproc
+    # each process's devices must tile whole, contiguous p-rows of the mesh
+    # (jax.make_array_from_process_local_data maps local blocks onto the
+    # process-addressed extent) — make_multislice_mesh(n_slices=nproc, ...)
+    # produces exactly this layout
+    p_shards, d_shards = pod.mesh.devices.shape
+    n_local = len(jax.local_devices())
+    if p_shards % nproc or (p_shards // nproc) * d_shards != n_local:
+        raise ValueError(
+            f"mesh ({p_shards}, {d_shards}) does not split its p axis "
+            f"evenly over {nproc} processes x {n_local} local devices; "
+            f"build it with make_multislice_mesh(n_slices={nproc}, "
+            f"p_per_slice={n_local}//d_shards, d_shards)"
+        )
+    # the participant axis must honor BOTH grains: the mesh p axis (via
+    # pod.padded_shape) and an integer per-process row count
+    p_grain = math.lcm(p_shards, nproc)
+    P_lift = -(-P_global // p_grain) * p_grain
+    P_pad, d_pad = pod.padded_shape(P_lift, d_total)
+    assert P_pad == P_lift and P_pad % nproc == 0
+    P_pad_local = P_pad // nproc
+    padded = np.zeros((P_pad_local, d_pad), dtype=inputs.dtype)
+    padded[:P_local, :d_total] = inputs
+
+    if key is None:
+        key = fresh_prng_key()
+    # one round key for the whole pod: process 0's key wins
+    key = multihost_utils.broadcast_one_to_all(key)
+
+    step = pod._get_step(P_pad, d_pad)
+
+    sharding = NamedSharding(pod.mesh, P("p", "d"))
+    with timed_phase("mesh.multihost_round"):
+        global_inputs = jax.make_array_from_process_local_data(
+            sharding, padded, (P_pad, d_pad)
+        )
+        out = step(global_inputs, key)
+        # out is dim-sharded across the global mesh; allgather to every host
+        result = multihost_utils.process_allgather(out, tiled=True)
+    return np.asarray(result)[:d_total]

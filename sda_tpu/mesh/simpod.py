@@ -458,18 +458,24 @@ class SimulatedPod:
             padded = np.zeros((P_pad, d_pad), dtype=inputs.dtype)
             padded[:P_total, :d_total] = inputs
             inputs = padded
-        shape = (P_pad, d_pad)
-        if self._step is None or self._step_shape != shape:
-            self._step = self._build(*shape)
-            self._step_shape = shape
+        step = self._get_step(P_pad, d_pad)
         sharding = NamedSharding(self.mesh, P("p", "d"))
         # first round per shape includes jit compilation (jax.jit is lazy):
         # it shows in the phase stats as max_s >> min_s
         with timed_phase("mesh.round"):
             device_inputs = jax.device_put(jnp.asarray(inputs), sharding)
-            out = self._step(device_inputs, key)
+            out = step(device_inputs, key)
             out.block_until_ready()
         return out[:d_total]
+
+    def _get_step(self, P_pad: int, d_pad: int):
+        """The jitted SPMD round for an already-padded shape (one-shape
+        cache, shared by aggregate() and multihost.aggregate_process_local)."""
+        shape = (P_pad, d_pad)
+        if self._step is None or self._step_shape != shape:
+            self._step = self._build(*shape)
+            self._step_shape = shape
+        return self._step
 
     def aggregate_fn(self, P_total: int, d_total: int):
         """The raw jitted SPMD round for benchmarking/compile checks

@@ -1,0 +1,78 @@
+"""Multi-controller execution: TWO OS processes, each owning 4 CPU devices,
+jointly run one SimulatedPod round over gRPC collectives — the same
+multi-process code path a real multi-host TPU deployment uses
+(mesh/multihost.py). Each process contributes only its process-local
+participant rows; both must independently reveal the identical global
+aggregate.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+port, pid = sys.argv[1], int(sys.argv[2])
+from sda_tpu.mesh import multihost
+multihost.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+
+import numpy as np
+from sda_tpu.mesh import SimulatedPod, make_multislice_mesh
+from sda_tpu.protocol import FullMasking, PackedShamirSharing
+
+assert jax.process_count() == 2
+assert len(jax.devices()) == 8          # global view
+assert len(jax.local_devices()) == 4    # this host's slice
+
+scheme = PackedShamirSharing(3, 8, 4, 433, 354, 150)
+# one slice block per process: participant data never crosses hosts
+mesh = make_multislice_mesh(2, 2, 2)
+pod = SimulatedPod(scheme, masking_scheme=FullMasking(433), mesh=mesh)
+
+def rows(process):  # deterministic per-process participant rows
+    return np.random.default_rng(100 + process).integers(0, 433, size=(2, 12))
+
+out = multihost.aggregate_process_local(
+    pod, rows(pid), key=jax.random.PRNGKey(7)
+)
+expected = (rows(0).sum(axis=0) + rows(1).sum(axis=0)) % 433
+np.testing.assert_array_equal(out, expected)
+print(f"MULTIHOST_OK process={pid}", flush=True)
+"""
+
+
+def test_two_process_pod_round():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(port), str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=540)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"process {pid} failed:\n{err[-3000:]}"
+        assert f"MULTIHOST_OK process={pid}" in out
