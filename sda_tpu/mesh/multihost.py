@@ -89,15 +89,8 @@ def aggregate_process_local(pod, local_inputs, key=None):
     # (jax.make_array_from_process_local_data maps local blocks onto the
     # process-addressed extent) — make_multislice_mesh(n_slices=nproc, ...)
     # produces exactly this layout
-    p_shards, d_shards = pod.mesh.devices.shape
-    n_local = len(jax.local_devices())
-    if p_shards % nproc or (p_shards // nproc) * d_shards != n_local:
-        raise ValueError(
-            f"mesh ({p_shards}, {d_shards}) does not split its p axis "
-            f"evenly over {nproc} processes x {n_local} local devices; "
-            f"build it with make_multislice_mesh(n_slices={nproc}, "
-            f"p_per_slice={n_local}//d_shards, d_shards)"
-        )
+    _check_mesh_process_split(pod.mesh, nproc)
+    p_shards = pod.mesh.devices.shape[0]
     # the participant axis must honor BOTH grains: the mesh p axis (via
     # pod.padded_shape) and an integer per-process row count
     p_grain = math.lcm(p_shards, nproc)
@@ -124,3 +117,100 @@ def aggregate_process_local(pod, local_inputs, key=None):
         # out is dim-sharded across the global mesh; allgather to every host
         result = multihost_utils.process_allgather(out, tiled=True)
     return np.asarray(result)[:d_total]
+
+
+def _check_mesh_process_split(mesh, nproc: int) -> None:
+    import jax
+
+    p_shards, d_shards = mesh.devices.shape
+    n_local = len(jax.local_devices())
+    if p_shards % nproc or (p_shards // nproc) * d_shards != n_local:
+        raise ValueError(
+            f"mesh ({p_shards}, {d_shards}) does not split its p axis "
+            f"evenly over {nproc} processes x {n_local} local devices; "
+            f"build it with make_multislice_mesh(n_slices={nproc}, "
+            f"p_per_slice={n_local}//d_shards, d_shards)"
+        )
+
+
+def streamed_aggregate_process_local(
+    spod, get_local_block, local_participants: int, dimension: int, key=None
+):
+    """Flagship-scale multihost rounds: every process STREAMS its own
+    participant rows through the StreamedPod tile loop.
+
+    ``get_local_block(lp0, lp1, d0, d1)`` returns this process's local rows
+    ``[lp0:lp1]`` for dim window ``[d0:d1)`` (short edge blocks are
+    zero-padded here). All processes must report the same
+    ``local_participants``/``dimension`` and iterate in lockstep — each
+    global tile is assembled from per-process local blocks with
+    ``make_array_from_process_local_data``, so no host ever materializes a
+    global tile, let alone the global matrix. Aggregation is a sum, so the
+    (process-major) global participant ordering is irrelevant to the
+    result. Returns the [dimension] aggregate on every process.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..crypto.core import fresh_prng_key
+    from ..utils import timed_phase
+
+    nproc = jax.process_count()
+    _check_mesh_process_split(spod.mesh, nproc)
+    shapes = multihost_utils.process_allgather(
+        jnp.asarray([local_participants, dimension], dtype=jnp.int32)
+    ).reshape(nproc, 2)
+    if not (shapes == shapes[0]).all():
+        raise ValueError(f"process-local stream shapes disagree: {shapes.tolist()}")
+
+    if key is None:
+        key = fresh_prng_key()
+    key = multihost_utils.broadcast_one_to_all(key)
+
+    pc = spod.participants_chunk
+    # StreamedPod rounds pc up to a multiple of p_shards, and the mesh check
+    # guarantees nproc divides p_shards — so whole local rows per tile
+    assert pc % nproc == 0, (pc, nproc)
+    pc_local = pc // nproc
+    sharding = NamedSharding(spod.mesh, P("p", "d"))
+    dt = spod._field.dtype
+
+    def zeros_global(shape):
+        def cb(index):
+            sizes = tuple(
+                (s.stop if s.stop is not None else dim)
+                - (s.start if s.start is not None else 0)
+                for s, dim in zip(index, shape)
+            )
+            return np.zeros(sizes, dt)
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+    def make_accs(d_size):
+        sS, sM = spod._acc_shapes(d_size)
+        return zeros_global(sS), zeros_global(sM)
+
+    def make_block(p0, p1, d0, d1, d_size):
+        # global tile rows [p0:p1) map process-major onto local rows
+        lp0, lp1 = p0 // nproc, min(p1 // nproc, local_participants)
+        host = np.asarray(get_local_block(lp0, max(lp0, lp1), d0, d1))
+        if host.shape != (pc_local, d_size):
+            padded = np.zeros((pc_local, d_size), dtype=host.dtype)
+            padded[: host.shape[0], : host.shape[1]] = host
+            host = padded
+        return jax.make_array_from_process_local_data(
+            sharding, host, (pc, d_size)
+        )
+
+    def fetch(arr):
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+    with timed_phase("mesh.multihost_streamed_round"):
+        # drive over the GLOBAL participant count so every process iterates
+        # the identical tile sequence in lockstep
+        return spod.drive_tiles(
+            local_participants * nproc, dimension, key,
+            make_block=make_block, make_accs=make_accs, fetch=fetch,
+        )

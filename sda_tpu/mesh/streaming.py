@@ -348,39 +348,62 @@ class StreamedPod:
         self, get_block: BlockProvider, participants: int, dimension: int, key=None
     ) -> np.ndarray:
         """Stream all blocks; returns the [dimension] aggregate (host array)."""
+        sharding = NamedSharding(self.mesh, P("p", "d"))
+
+        def make_block(p0, p1, d0, d1, d_size):
+            pc = self.participants_chunk
+            host = np.asarray(get_block(p0, p1, d0, d1))
+            if host.shape != (pc, d_size):  # zero-pad the edge tiles
+                padded = np.zeros((pc, d_size), dtype=host.dtype)
+                padded[: host.shape[0], : host.shape[1]] = host
+                host = padded
+            return jax.device_put(jnp.asarray(host), sharding)
+
+        return self.drive_tiles(
+            participants, dimension, key,
+            make_block=make_block, make_accs=self._new_accs,
+            fetch=np.asarray,
+        )
+
+    def drive_tiles(
+        self, participants: int, dimension: int, key,
+        *, make_block, make_accs, fetch,
+    ) -> np.ndarray:
+        """The tile loop shared by single-host streaming and the multihost
+        driver (mesh/multihost.py): d-tiles outer, participant tiles inner,
+        one accumulate step per tile, one collective finale per d-tile.
+
+        ``make_block(p0, p1, d0, d1, d_size)`` supplies each global
+        [participants_chunk, d_size] device block; ``make_accs(d_size)``
+        the zeroed (shares, mask) accumulators; ``fetch(arr)`` brings a
+        d-sharded finale result to host numpy. The tile/key derivation here
+        is THE definition — mask windows and share randomness depend on it.
+        """
         if key is None:
             from ..crypto.core import fresh_prng_key
 
             key = fresh_prng_key()
-        p_shards, _ = self.mesh.devices.shape
         pc, dc = self.participants_chunk, self.dim_chunk
-        sharding = NamedSharding(self.mesh, P("p", "d"))
         out = np.empty(dimension, dtype=np.int64)
         for di_ix, d0 in enumerate(range(0, dimension, dc)):
             d1 = min(d0 + dc, dimension)
             d_size = -(-(d1 - d0) // self._grain) * self._grain  # pad to grain
-            acc_shares, acc_mask = self._new_accs(d_size)
+            acc_shares, acc_mask = make_accs(d_size)
             for pi_ix, p0 in enumerate(range(0, participants, pc)):
                 p1 = min(p0 + pc, participants)
-                host = np.asarray(get_block(p0, p1, d0, d1))
-                if host.shape != (pc, d_size):  # zero-pad the edge tiles
-                    padded = np.zeros((pc, d_size), dtype=host.dtype)
-                    padded[: host.shape[0], : host.shape[1]] = host
-                    host = padded
-                block = jax.device_put(jnp.asarray(host), sharding)
-                tile_key = _tile_key(key, pi_ix, di_ix)
-                step = self._steps.get(host.shape)
+                block = make_block(p0, p1, d0, d1, d_size)
+                step = self._steps.get((pc, d_size))
                 if step is None:
-                    step = self._steps[host.shape] = self._step_fn(host.shape)
+                    step = self._steps[(pc, d_size)] = self._step_fn((pc, d_size))
                 acc_shares, acc_mask = step(
-                    block, tile_key, key,
+                    block, _tile_key(key, pi_ix, di_ix), key,
                     jnp.int32(p0), jnp.int32(d0 // 8),
                     acc_shares, acc_mask,
                 )
             final = self._finals.get(d_size)
             if final is None:
                 final = self._finals[d_size] = self._final_fn(d_size)
-            out[d0:d1] = np.asarray(final(acc_shares, acc_mask))[: d1 - d0]
+            out[d0:d1] = fetch(final(acc_shares, acc_mask))[: d1 - d0]
         return out
 
     def aggregate(self, inputs, key=None) -> np.ndarray:

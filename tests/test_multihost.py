@@ -43,6 +43,24 @@ out = multihost.aggregate_process_local(
 )
 expected = (rows(0).sum(axis=0) + rows(1).sum(axis=0)) % 433
 np.testing.assert_array_equal(out, expected)
+
+# streamed flagship-scale path: every process streams its own rows in
+# tiles; ragged local count (5 rows each) and several dim tiles
+from sda_tpu.mesh import StreamedPod
+from sda_tpu.protocol import AdditiveSharing, ChaChaMasking
+spod = StreamedPod(
+    AdditiveSharing(share_count=8, modulus=433),
+    ChaChaMasking(433, 40, 128),
+    mesh=mesh, participants_chunk=4, dim_chunk=16,
+)
+def srows(process):
+    return np.random.default_rng(900 + process).integers(0, 433, size=(5, 40))
+mine = srows(pid)
+sout = multihost.streamed_aggregate_process_local(
+    spod, lambda lp0, lp1, d0, d1: mine[lp0:lp1, d0:d1],
+    local_participants=5, dimension=40, key=jax.random.PRNGKey(9),
+)
+np.testing.assert_array_equal(sout, (srows(0).sum(0) + srows(1).sum(0)) % 433)
 print(f"MULTIHOST_OK process={pid}", flush=True)
 """
 
