@@ -33,10 +33,85 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--streaming", action="store_true",
                         help="chunked single-chip rounds (HBM-exceeding sizes)")
     parser.add_argument("--participants-chunk", type=int, default=64)
+    parser.add_argument("--multihost", type=int, metavar="N", default=0,
+                        help="spawn N OS processes (gRPC collectives); each "
+                             "owns 1/N of the participants and devices")
+    parser.add_argument("--devices-per-process", type=int, default=4,
+                        help="virtual CPU devices per multihost process")
     parser.add_argument("--verify", action="store_true",
                         help="recompute the plain sum on host and compare")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     return parser
+
+
+def _run_multihost(args, argv=None) -> int:
+    """Coordinator: validate flags, spawn N workers re-invoking this CLI
+    (output to temp files — captured PIPEs can deadlock a worker mid-
+    collective once its 64 KiB buffer fills); worker 0's JSON line is the
+    result."""
+    import os
+    import socket
+    import subprocess
+    import tempfile
+
+    n = args.multihost
+    # fail fast, once, before any process exists
+    if args.participants % n:
+        print(f"error: --participants {args.participants} must be divisible "
+              f"by --multihost {n}", file=sys.stderr)
+        return 1
+    if args.clerks % n:
+        print(f"error: --clerks {args.clerks} must be divisible by "
+              f"--multihost {n}", file=sys.stderr)
+        return 1
+    # the mesh contract (multihost._check_mesh_process_split) needs every
+    # local device used: p_per_slice * d_shards == local devices. With
+    # d_shards=1 that means the per-process device count must divide the
+    # per-process committee span, so shrink it until it does.
+    devs = args.devices_per_process
+    while devs > 1 and args.clerks % (n * devs):
+        devs -= 1
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    # append-or-substitute the device-count flag: don't drop user XLA flags
+    flag = f"--xla_force_host_platform_device_count={devs}"
+    existing = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                if not f.startswith("--xla_force_host_platform_device_count")]
+    env_base = dict(os.environ, XLA_FLAGS=" ".join(existing + [flag]))
+    worker_argv = list(argv) if argv is not None else sys.argv[1:]
+    procs = []
+    logs = []
+    for pid in range(n):
+        env = dict(env_base, SDA_SIM_COORD=f"localhost:{port}",
+                   SDA_SIM_NPROC=str(n), SDA_SIM_PID=str(pid))
+        log = tempfile.TemporaryFile(mode="w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "sda_tpu.cli.sim", *worker_argv],
+            env=env, stdout=log, stderr=subprocess.STDOUT, text=True,
+        ))
+    rc = 0
+    for pid, (p, log) in enumerate(zip(procs, logs)):
+        p.wait()
+        log.seek(0)
+        out = log.read()
+        log.close()
+        if p.returncode != 0:
+            print(out[-2000:], file=sys.stderr)
+            rc = p.returncode
+        elif pid == 0:
+            # collective runtimes (Gloo) chat on stdout; forward only the
+            # result line so the one-JSON-line contract holds
+            for line in out.splitlines():
+                if line.startswith("{"):
+                    try:
+                        json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    print(line)
+    return rc
 
 
 def main(argv=None) -> int:
@@ -50,6 +125,30 @@ def main(argv=None) -> int:
     )
 
     configure_logging(args.verbose)
+
+    import os
+
+    coord = os.environ.get("SDA_SIM_COORD")
+    if args.multihost and coord is None:
+        return _run_multihost(args, argv)
+    if coord is not None:
+        # multihost worker: backend + distributed init BEFORE any jax op
+        import jax as _jax
+
+        platform = os.environ.get("SDA_SIM_PLATFORM", "cpu")
+        if platform:
+            _jax.config.update("jax_platforms", platform)
+        from ..mesh import multihost as _mh
+
+        _mh.initialize(coord, int(os.environ["SDA_SIM_NPROC"]),
+                       int(os.environ["SDA_SIM_PID"]))
+    else:
+        # same robustness rule as bench.py: never init the axon TPU backend
+        # in-process without a killable probe — it can hang indefinitely
+        # when the chip tunnel is down (SDA_SIM_PLATFORM=cpu|tpu overrides)
+        from ..utils.backend import select_platform, use_platform
+
+        use_platform(select_platform("SDA_SIM_PLATFORM"))
 
     import jax
     import numpy as np
@@ -68,12 +167,47 @@ def main(argv=None) -> int:
         "chacha": ChaChaMasking(p, dim, 128),
     }[args.mask]
     rng = np.random.default_rng(0)
-    inputs = rng.integers(0, 1 << 20, size=(args.participants, dim), dtype=np.int64)
-
+    if coord is None:
+        inputs = rng.integers(0, 1 << 20, size=(args.participants, dim),
+                              dtype=np.int64)
     reset_phase_report()
     reset_counters()
     key = jax.random.PRNGKey(0)
-    if args.streaming:
+    if coord is not None:
+        from ..mesh import StreamedPod, make_multislice_mesh, multihost as mh
+
+        nproc = jax.process_count()
+        pid = jax.process_index()
+        # the coordinator validated divisibility and sized the per-process
+        # device count so every local device is one committee p-row
+        mesh = make_multislice_mesh(nproc, len(jax.local_devices()), 1)
+        P_local = args.participants // nproc
+        # each worker draws ONLY its own rows — at flagship scale no host
+        # can hold the global matrix (that is the point of streamed mode)
+        local = np.random.default_rng(1000 + pid).integers(
+            0, 1 << 20, size=(P_local, dim), dtype=np.int64
+        )
+        if args.streaming:
+            agg = spod = StreamedPod(
+                scheme, masking, mesh=mesh,
+                participants_chunk=args.participants_chunk,
+                dim_chunk=min(dim, 3 * (1 << 19)),
+            )
+            start = time.perf_counter()
+            out = mh.streamed_aggregate_process_local(
+                spod, lambda lp0, lp1, d0, d1: local[lp0:lp1, d0:d1],
+                local_participants=P_local, dimension=dim, key=key,
+            )
+            elapsed = time.perf_counter() - start
+            mode = f"multihost x{nproc} streamed mesh {mesh.devices.shape}"
+        else:
+            pod = SimulatedPod(scheme, masking, mesh=mesh)
+            out = np.asarray(mh.aggregate_process_local(pod, local, key=key))
+            start = time.perf_counter()
+            out = np.asarray(mh.aggregate_process_local(pod, local, key=key))
+            elapsed = time.perf_counter() - start
+            mode = f"multihost x{nproc} simpod mesh {mesh.devices.shape}"
+    elif args.streaming:
         agg = StreamingAggregator(
             scheme, masking,
             participants_chunk=args.participants_chunk,
@@ -102,7 +236,18 @@ def main(argv=None) -> int:
         "elements_per_sec": round(args.participants * dim / elapsed, 1),
     }
     if args.verify:
-        expected = inputs.astype(object).sum(axis=0) % p
+        if coord is not None:
+            # sum the per-process local sums without any host seeing the
+            # global matrix
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+
+            local_sums = multihost_utils.process_allgather(
+                jnp.asarray(local.sum(axis=0))
+            )
+            expected = np.asarray(local_sums).astype(object).sum(axis=0) % p
+        else:
+            expected = inputs.astype(object).sum(axis=0) % p
         result["exact"] = bool((out.astype(object) == expected).all())
     phases = phase_report()
     if phases:
