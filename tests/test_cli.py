@@ -123,3 +123,30 @@ def test_cli_paillier_aggregation(httpd, tmp_path, capsys):
     for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
         sda(who, "clerk", "--once")
     assert sda("recipient", "aggregations", "reveal", agg_id) == "11 22 33 44"
+
+
+def test_sim_cli_multihost(tmp_path, capsys):
+    """`sda-sim --multihost 2` spawns two real worker processes over gRPC
+    collectives and prints exactly one JSON result line (worker chatter
+    filtered), exact against the distributed plain sum."""
+    import json
+
+    from sda_tpu.cli import sim
+
+    rc = sim.main([
+        "--participants", "8", "--dim", "24", "--clerks", "8",
+        "--multihost", "2", "--devices-per-process", "4", "--verify",
+    ])
+    assert rc == 0
+    out_lines = capsys.readouterr().out.strip().splitlines()
+    assert len(out_lines) == 1
+    result = json.loads(out_lines[0])
+    assert result["mode"].startswith("multihost x2")
+    assert result["exact"] is True
+
+    # invalid combination is rejected before any process spawns
+    rc = sim.main([
+        "--participants", "8", "--dim", "24", "--clerks", "8",
+        "--multihost", "3",
+    ])
+    assert rc == 1
