@@ -70,11 +70,11 @@ def _run(platform: str, use_pallas: bool) -> dict:
 
         # sweepable kernel knobs (hardware tuning): participants folded per
         # matmul block, and the lane-dim tile width
-        p_block = int(os.environ.get("SDA_PALLAS_PBLOCK", 16))
-        tile_env = os.environ.get("SDA_PALLAS_TILE")
+        from sda_tpu.utils.benchtime import pallas_knobs
+
+        p_block, tile = pallas_knobs()
         fn = jax.jit(single_chip_round_pallas(
-            scheme, FullMasking(p), p_block=p_block,
-            tile=int(tile_env) if tile_env else None,
+            scheme, FullMasking(p), p_block=p_block, tile=tile,
         ))
     else:
         fn = jax.jit(single_chip_round(scheme, FullMasking(p)))

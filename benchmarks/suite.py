@@ -194,7 +194,12 @@ def _round_bench(name, participants, dim):
     if use_pallas:
         from sda_tpu.fields.pallas_round import single_chip_round_pallas
 
-        fn = jax.jit(single_chip_round_pallas(scheme, FullMasking(p)))
+        from sda_tpu.utils.benchtime import pallas_knobs
+
+        p_block, tile = pallas_knobs()
+        fn = jax.jit(single_chip_round_pallas(
+            scheme, FullMasking(p), p_block=p_block, tile=tile,
+        ))
     else:
         fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
     rng = np.random.default_rng(0)

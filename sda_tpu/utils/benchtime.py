@@ -70,3 +70,16 @@ def marginal_seconds(
         "fixed_overhead_s": round(max(t1 - r1 * per, 0.0), 4),
     }
     return per, info
+
+
+def pallas_knobs():
+    """(p_block, tile) kernel-tuning knobs from the environment —
+    SDA_PALLAS_PBLOCK (default 16) and SDA_PALLAS_TILE (default None =
+    auto), shared by bench.py, benchmarks/suite.py and the sweep harness."""
+    import os
+
+    tile_env = os.environ.get("SDA_PALLAS_TILE")
+    return (
+        int(os.environ.get("SDA_PALLAS_PBLOCK", 16)),
+        int(tile_env) if tile_env else None,
+    )

@@ -276,3 +276,4 @@ def test_small_work_host_path_is_exact_and_device_free(monkeypatch):
     mask, masked = masker.mask(secrets)
     np.testing.assert_array_equal(masked, (secrets + 7) % 433)
     np.testing.assert_array_equal(masker.unmask(mask, masked), secrets)
+

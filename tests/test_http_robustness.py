@@ -200,3 +200,22 @@ def test_aggregation_serde_roundtrip_fuzz():
         assert back.committee_sharing_scheme == agg.committee_sharing_scheme
         assert back.masking_scheme.to_obj() == agg.masking_scheme.to_obj()
         assert back.recipient_encryption_scheme == agg.recipient_encryption_scheme
+
+
+def test_varint_decode_fuzz_never_crashes():
+    """Garbage byte streams: clean ValueError or a valid decode, never an
+    unhandled exception — the decoder faces untrusted sealed-box payloads."""
+    from sda_tpu.crypto import varint
+
+    import numpy as np
+
+    rng = np.random.default_rng(41)
+    for size in [0, 1, 3, 9, 64, 513]:
+        for _ in range(50):
+            raw = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+            try:
+                decoded = varint.decode(raw)
+                # decodable garbage must round-trip
+                np.testing.assert_array_equal(varint.decode(varint.encode(decoded)), decoded)
+            except ValueError:
+                pass

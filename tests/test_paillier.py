@@ -511,3 +511,34 @@ def test_premix_survives_malformed_participation():
         job = store.poll_clerking_job(recipient.agent.id)
     assert job is not None
     assert len(job.encryptions) == 2  # unmixed: one per participation
+
+
+def test_crt_decrypt_matches_textbook():
+    """The CRT shortcut must agree with the textbook lambda/mu path."""
+    pk, sk = paillier.keygen(512)
+    n, n2 = pk.n, pk.n_squared
+    lam = (sk.p - 1) * (sk.q - 1) // __import__("math").gcd(sk.p - 1, sk.q - 1)
+    mu = pow((pow(1 + n, lam, n2) - 1) // n, -1, n)
+
+    rng = np.random.default_rng(23)
+    for _ in range(25):
+        m = int(rng.integers(0, 1 << 62)) * int(rng.integers(1, 1 << 60)) % n
+        c = paillier.encrypt(pk, m)
+        textbook = (pow(c, lam, n2) - 1) // n * mu % n
+        assert paillier.decrypt(sk, c) == textbook == m
+
+
+def test_unframe_fuzz_never_crashes():
+    """Random garbage payloads must raise ValueError (or parse), never
+    IndexError/OverflowError/hang."""
+    from sda_tpu.crypto.encryption import _unframe_paillier
+
+    rng = np.random.default_rng(31)
+    for size in [0, 1, 2, 7, 64, 512]:
+        for _ in range(50):
+            raw = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+            try:
+                count, summands, cts = _unframe_paillier(raw)
+                assert count >= 0 and summands >= 1
+            except ValueError:
+                pass
