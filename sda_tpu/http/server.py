@@ -114,6 +114,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, status: int, obj=None, resource_not_found=False):
         body = b"" if obj is None else json.dumps(obj).encode("utf-8")
+        # per-request status line + counters (reference: the rouille wrapper
+        # logs method/path/status per request, server-http/src/lib.rs:105-122).
+        # Counted BEFORE the body write: once a client has the response, the
+        # counters must already reflect it (no read-after-response race).
+        dt_ms = (time.perf_counter() - self._t0) * 1e3 if self._t0 else 0.0
+        log.info("%s %s -> %d (%.1fms)", self.command, self.path, status, dt_ms)
+        if not self._counted:  # a failed write re-enters _reply via the
+            self._counted = True  # _route catch-all: count the request once
+            counts = getattr(self.server, "status_counts", None)
+            if counts is not None:
+                with self.server.stats_lock:  # type: ignore[attr-defined]
+                    counts[status] = counts.get(status, 0) + 1
+            metrics.count("http.request")
+            metrics.count(f"http.status.{status}")
         self.send_response(status)
         if resource_not_found:
             self.send_header("X-Resource-Not-Found", "true")
@@ -121,16 +135,6 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
-        # per-request status line + counters (reference: the rouille wrapper
-        # logs method/path/status per request, server-http/src/lib.rs:105-122)
-        dt_ms = (time.perf_counter() - self._t0) * 1e3 if self._t0 else 0.0
-        log.info("%s %s -> %d (%.1fms)", self.command, self.path, status, dt_ms)
-        counts = getattr(self.server, "status_counts", None)
-        if counts is not None:
-            with self.server.stats_lock:  # type: ignore[attr-defined]
-                counts[status] = counts.get(status, 0) + 1
-        metrics.count("http.request")
-        metrics.count(f"http.status.{status}")
 
     def _reply_option(self, obj):
         if obj is None:
@@ -139,10 +143,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, obj.to_obj())
 
     _t0 = 0.0
+    _counted = False
 
     # -- dispatch ----------------------------------------------------------
     def _route(self, method: str):
         self._t0 = time.perf_counter()
+        self._counted = False  # per-request (connections are reused)
         url = urlparse(self.path)
         path = url.path.rstrip("/")
         query = parse_qs(url.query)
