@@ -542,3 +542,63 @@ def test_unframe_fuzz_never_crashes():
                 assert count >= 0 and summands >= 1
             except ValueError:
                 pass
+
+
+@pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+def test_premix_over_http_seam(tmp_path):
+    """Premixing is transparent at the REST seam: clerks polling over HTTP
+    receive the homomorphically combined batches and the round stays exact."""
+    from sda_tpu.http.client import SdaHttpClient
+    from sda_tpu.http.server import SdaHttpServer
+    from sda_tpu.store import Filebased
+
+    service = new_memory_server()
+    service.server.premix_paillier = True
+    httpd = SdaHttpServer(service, bind="127.0.0.1:0").start_background()
+    try:
+        def new_client(name):
+            ks = Filebased(tmp_path / name)
+            agent = SdaClient.new_agent(ks)
+            return SdaClient(agent, ks, SdaHttpClient(httpd.address, ks))
+
+        recipient = new_client("recipient")
+        recipient_key = recipient.new_encryption_key(SCHEME)
+        recipient.upload_agent()
+        recipient.upload_encryption_key(recipient_key)
+        aggregation = Aggregation(
+            id=AggregationId.random(),
+            title="premix-http",
+            vector_dimension=4,
+            modulus=433,
+            recipient=recipient.agent.id,
+            recipient_key=recipient_key,
+            masking_scheme=FullMasking(433),
+            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+            recipient_encryption_scheme=SCHEME,
+            committee_encryption_scheme=SCHEME,
+        )
+        recipient.upload_aggregation(aggregation)
+        clerks = [new_client(f"clerk-{i}") for i in range(3)]
+        for clerk in clerks:
+            clerk.upload_agent()
+            clerk.upload_encryption_key(clerk.new_encryption_key(SCHEME))
+        recipient.begin_aggregation(aggregation.id)
+        for i in range(4):
+            participant = new_client(f"p-{i}")
+            participant.upload_agent()
+            participant.participate([i, 2, 3, 4], aggregation.id)
+        recipient.end_aggregation(aggregation.id)
+
+        # a clerk's job, fetched over REST, holds ONE premixed batch
+        polled = service.get_clerking_job(clerks[0].agent, clerks[0].agent.id)
+        assert polled is not None and len(polled.encryptions) == 1
+
+        recipient.run_chores(-1)
+        for clerk in clerks:
+            clerk.run_chores(-1)
+        output = recipient.reveal_aggregation(aggregation.id)
+        np.testing.assert_array_equal(
+            output.positive().values, [6 % 433, 8, 12, 16]
+        )
+    finally:
+        httpd.shutdown()
