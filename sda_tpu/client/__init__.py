@@ -25,6 +25,7 @@ from ..protocol import (
     Committee,
     EncryptionKeyId,
     NotFound,
+    PackedPaillierEncryption,
     Participation,
     ParticipationId,
     SdaService,
@@ -230,13 +231,48 @@ class SdaClient:
         self.service.create_aggregation(self.agent, aggregation)
 
     def begin_aggregation(self, aggregation_id: AggregationId) -> None:
-        """Elect a committee from service suggestions (receive.rs:48-62)."""
+        """Elect a committee from service suggestions (receive.rs:48-62).
+
+        Candidates are filtered to keys of the variant the aggregation's
+        committee encryption scheme needs (the reference has a single
+        scheme so never faces this; with Paillier in the lattice, electing
+        a Sodium-keyed clerk would only fail later at participate time).
+        """
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
             raise NotFound(f"unknown aggregation {aggregation_id}")
         candidates = self.service.suggest_committee(self.agent, aggregation_id)
         needed = aggregation.committee_sharing_scheme.output_size
-        selected = [(c.id, c.keys[0]) for c in candidates[:needed]]
+        want = (
+            "PackedPaillier"
+            if isinstance(aggregation.committee_encryption_scheme,
+                          PackedPaillierEncryption)
+            else "Sodium"
+        )
+        # filtered CLIENT-side on purpose: committee election is the
+        # recipient's judgment call in the reference protocol
+        # (receive.rs:48-62), and the recipient should not trust the broker
+        # to pre-filter; the extra key fetches are bounded by the
+        # suggestion-list size. Signature verification uses the same path
+        # participate does, so an unverifiable key can't be elected only to
+        # fail every participant later.
+        selected = []
+        for c in candidates:
+            if len(selected) == needed:
+                break
+            for key_id in c.keys:
+                try:
+                    key = self._fetch_verified_key(c.id, key_id)
+                except (NotFound, ValueError):
+                    continue
+                if key.variant == want:
+                    selected.append((c.id, key_id))
+                    break
+        if len(selected) < needed:
+            raise NotFound(
+                f"only {len(selected)} of {needed} committee candidates "
+                f"have a verified {want} encryption key"
+            )
         self.service.create_committee(
             self.agent, Committee(aggregation=aggregation_id, clerks_and_keys=selected)
         )

@@ -589,9 +589,16 @@ def test_premix_over_http_seam(tmp_path):
             participant.participate([i, 2, 3, 4], aggregation.id)
         recipient.end_aggregation(aggregation.id)
 
-        # a clerk's job, fetched over REST, holds ONE premixed batch
-        polled = service.get_clerking_job(clerks[0].agent, clerks[0].agent.id)
-        assert polled is not None and len(polled.encryptions) == 1
+        # each elected clerk's job, fetched over REST, holds ONE premixed
+        # batch (election picks 3 of the 4 paillier-keyed agents — the
+        # recipient is eligible too — in store-dependent order)
+        premixed_jobs = 0
+        for member in clerks + [recipient]:
+            polled = service.get_clerking_job(member.agent, member.agent.id)
+            if polled is not None:
+                assert len(polled.encryptions) == 1
+                premixed_jobs += 1
+        assert premixed_jobs == 3
 
         recipient.run_chores(-1)
         for clerk in clerks:
@@ -602,3 +609,67 @@ def test_premix_over_http_seam(tmp_path):
         )
     finally:
         httpd.shutdown()
+
+
+@pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+def test_committee_election_filters_by_key_variant():
+    """A Paillier aggregation must not elect Sodium-keyed clerks (they
+    could never decrypt their jobs); election skips them and fails
+    loudly when too few matching candidates exist."""
+    service = new_memory_server()
+
+    def new_client():
+        keystore = MemoryKeystore()
+        agent = SdaClient.new_agent(keystore)
+        return SdaClient(agent, keystore, service)
+
+    recipient = new_client()
+    recipient_key = recipient.new_encryption_key(SCHEME)
+    recipient.upload_agent()
+    recipient.upload_encryption_key(recipient_key)
+    aggregation = Aggregation(
+        id=AggregationId.random(),
+        title="election",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=recipient_key,
+        masking_scheme=FullMasking(433),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SCHEME,
+        committee_encryption_scheme=SCHEME,
+    )
+    recipient.upload_aggregation(aggregation)
+
+    # 3 sodium-keyed decoys + (recipient + 1) paillier-keyed candidates:
+    # one short of the 3-clerk committee -> loud error
+    paillier_clerks = []
+    for _ in range(3):
+        decoy = new_client()
+        decoy.upload_agent()
+        decoy.upload_encryption_key(decoy.new_encryption_key())
+    clerk = new_client()
+    clerk.upload_agent()
+    clerk.upload_encryption_key(clerk.new_encryption_key(SCHEME))
+    paillier_clerks.append(clerk)
+    from sda_tpu.protocol import NotFound
+
+    with pytest.raises(NotFound, match="PackedPaillier"):
+        recipient.begin_aggregation(aggregation.id)
+
+    # a third matching candidate arrives — holding BOTH key types, so the
+    # election must pick its PAILLIER key id, not just the right agent
+    third = new_client()
+    third.upload_agent()
+    third.upload_encryption_key(third.new_encryption_key())  # sodium decoy key
+    third_paillier_key = third.new_encryption_key(SCHEME)
+    third.upload_encryption_key(third_paillier_key)
+    paillier_clerks.append(third)
+    recipient.begin_aggregation(aggregation.id)
+    committee = service.get_committee(recipient.agent, aggregation.id)
+    eligible = {c.agent.id for c in paillier_clerks} | {recipient.agent.id}
+    elected = dict(committee.clerks_and_keys)
+    # exactly 3 eligible agents for a 3-clerk committee: all must be in,
+    # and the dual-keyed agent must be paired with its PAILLIER key id
+    assert set(elected) == eligible
+    assert elected[third.agent.id] == third_paillier_key
