@@ -52,10 +52,11 @@ def initialize(
 def aggregate_process_local(pod, local_inputs, key=None):
     """One secure-aggregation round over process-local participant rows.
 
-    Every process passes a ``[P_local, d]`` block of the SAME shape (ragged
-    counts must be zero-padded by the caller first — zero rows aggregate as
-    zero with their masks cancelling). Returns the full [d] aggregate as
-    host numpy, identical on every process.
+    Every process passes its own ``[P_local, d]`` block (same ``d``
+    everywhere; ragged ``P_local`` is fine — blocks are zero-padded to the
+    max, and zero rows aggregate as zero with their masks cancelling).
+    Returns the full [d] aggregate as host numpy, identical on every
+    process.
     """
     import math
 
@@ -73,16 +74,17 @@ def aggregate_process_local(pod, local_inputs, key=None):
     nproc = jax.process_count()
     P_local, d_total = inputs.shape
 
-    # all processes must agree on the global shape; cheapest agreement is
-    # requiring a common local row count (ragged blocks would silently
-    # misalign the participant axis)
+    # processes must agree on the dimension; ragged participant counts are
+    # fine — every process sizes its block to the max, and zero rows
+    # aggregate as zero with their masks cancelling
     shapes = multihost_utils.process_allgather(
         jnp.asarray([P_local, d_total], dtype=jnp.int32)
     ).reshape(nproc, 2)
-    if not (shapes == shapes[0]).all():
+    if not (shapes[:, 1] == d_total).all():
         raise ValueError(
-            f"process-local input shapes disagree: {shapes.tolist()}"
+            f"process-local dimensions disagree: {shapes[:, 1].tolist()}"
         )
+    P_local = int(shapes[:, 0].max())  # sizing only; `padded` zero-fills
 
     P_global = P_local * nproc
     # each process's devices must tile whole, contiguous p-rows of the mesh
@@ -99,7 +101,7 @@ def aggregate_process_local(pod, local_inputs, key=None):
     assert P_pad == P_lift and P_pad % nproc == 0
     P_pad_local = P_pad // nproc
     padded = np.zeros((P_pad_local, d_pad), dtype=inputs.dtype)
-    padded[:P_local, :d_total] = inputs
+    padded[: inputs.shape[0], :d_total] = inputs
 
     if key is None:
         key = fresh_prng_key()
@@ -140,9 +142,9 @@ def streamed_aggregate_process_local(
     participant rows through the StreamedPod tile loop.
 
     ``get_local_block(lp0, lp1, d0, d1)`` returns this process's local rows
-    ``[lp0:lp1]`` for dim window ``[d0:d1)`` (short edge blocks are
-    zero-padded here). All processes must report the same
-    ``local_participants``/``dimension`` and iterate in lockstep — each
+    ``[lp0:lp1]`` for dim window ``[d0:d1)`` (short or empty edge blocks
+    are zero-padded here, so ragged per-process ``local_participants`` is
+    fine). All processes iterate in lockstep to the max local count — each
     global tile is assembled from per-process local blocks with
     ``make_array_from_process_local_data``, so no host ever materializes a
     global tile, let alone the global matrix. Aggregation is a sum, so the
@@ -162,8 +164,15 @@ def streamed_aggregate_process_local(
     shapes = multihost_utils.process_allgather(
         jnp.asarray([local_participants, dimension], dtype=jnp.int32)
     ).reshape(nproc, 2)
-    if not (shapes == shapes[0]).all():
-        raise ValueError(f"process-local stream shapes disagree: {shapes.tolist()}")
+    if not (shapes[:, 1] == dimension).all():
+        raise ValueError(
+            f"process-local stream dimensions disagree: {shapes[:, 1].tolist()}"
+        )
+    # ragged local counts: iterate to the max, but never ask the caller's
+    # provider for rows beyond what IT declared — short/empty blocks are
+    # zero-padded below and zeros aggregate as zero
+    my_count = local_participants
+    local_participants = int(shapes[:, 0].max())
 
     if key is None:
         key = fresh_prng_key()
@@ -194,7 +203,8 @@ def streamed_aggregate_process_local(
 
     def make_block(p0, p1, d0, d1, d_size):
         # global tile rows [p0:p1) map process-major onto local rows
-        lp0, lp1 = p0 // nproc, min(p1 // nproc, local_participants)
+        lp0 = min(p0 // nproc, my_count)
+        lp1 = min(p1 // nproc, my_count)
         host = np.asarray(get_local_block(lp0, max(lp0, lp1), d0, d1))
         if host.shape != (pc_local, d_size):
             padded = np.zeros((pc_local, d_size), dtype=host.dtype)

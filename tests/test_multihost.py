@@ -35,8 +35,10 @@ scheme = PackedShamirSharing(3, 8, 4, 433, 354, 150)
 mesh = make_multislice_mesh(2, 2, 2)
 pod = SimulatedPod(scheme, masking_scheme=FullMasking(433), mesh=mesh)
 
-def rows(process):  # deterministic per-process participant rows
-    return np.random.default_rng(100 + process).integers(0, 433, size=(2, 12))
+def rows(process):  # deterministic, RAGGED per-process participant rows
+    return np.random.default_rng(100 + process).integers(
+        0, 433, size=(2 + process, 12)
+    )
 
 out = multihost.aggregate_process_local(
     pod, rows(pid), key=jax.random.PRNGKey(7)
@@ -45,7 +47,8 @@ expected = (rows(0).sum(axis=0) + rows(1).sum(axis=0)) % 433
 np.testing.assert_array_equal(out, expected)
 
 # streamed flagship-scale path: every process streams its own rows in
-# tiles; ragged local count (5 rows each) and several dim tiles
+# tiles; RAGGED local counts (5 rows on process 0, 4 on process 1) and
+# several dim tiles
 from sda_tpu.mesh import StreamedPod
 from sda_tpu.protocol import AdditiveSharing, ChaChaMasking
 spod = StreamedPod(
@@ -53,12 +56,18 @@ spod = StreamedPod(
     ChaChaMasking(433, 40, 128),
     mesh=mesh, participants_chunk=4, dim_chunk=16,
 )
-def srows(process):
-    return np.random.default_rng(900 + process).integers(0, 433, size=(5, 40))
+def srows(process):  # ragged: 5 rows on process 0, 4 on process 1
+    return np.random.default_rng(900 + process).integers(
+        0, 433, size=(5 - process, 40)
+    )
 mine = srows(pid)
+def strict_provider(lp0, lp1, d0, d1):
+    # the driver must never ask for rows beyond what THIS process declared
+    assert 0 <= lp0 <= lp1 <= mine.shape[0], (lp0, lp1, mine.shape)
+    return mine[lp0:lp1, d0:d1]
 sout = multihost.streamed_aggregate_process_local(
-    spod, lambda lp0, lp1, d0, d1: mine[lp0:lp1, d0:d1],
-    local_participants=5, dimension=40, key=jax.random.PRNGKey(9),
+    spod, strict_provider,
+    local_participants=mine.shape[0], dimension=40, key=jax.random.PRNGKey(9),
 )
 np.testing.assert_array_equal(sout, (srows(0).sum(0) + srows(1).sum(0)) % 433)
 print(f"MULTIHOST_OK process={pid}", flush=True)
