@@ -135,9 +135,16 @@ def main(argv=None) -> int:
             if args.encryption == "paillier":
                 # only min_modulus_bitsize matters for key material; window
                 # parameters are carried per-aggregation, not per-key
-                key_scheme = PackedPaillierEncryption(
-                    1, 32, 32, args.paillier_modulus_bits
-                )
+                try:
+                    key_scheme = PackedPaillierEncryption(
+                        1, 32, 32, args.paillier_modulus_bits
+                    )
+                except ValueError as e:
+                    print(f"error: --paillier-modulus-bits "
+                          f"{args.paillier_modulus_bits} is too small for "
+                          f"even one packed component window ({e}); use a "
+                          f"larger key size (e.g. 2048)", file=sys.stderr)
+                    return 1
             key_id = client.new_encryption_key(key_scheme)
             client.upload_encryption_key(key_id)
             store.put(f"keymeta-{key_id}", {"id": str(key_id)})
@@ -221,13 +228,45 @@ def main(argv=None) -> int:
                     return 1
             else:
                 encryption_scheme = SodiumEncryption()
+            recipient_key = _primary_key(client, store)
+            # fail at create time, not at every later participation, when the
+            # recipient's primary key can't serve the chosen encryption scheme
+            keypair = store.get_encryption_keypair(recipient_key)
+            want_variant = ("PackedPaillier" if args.encryption == "paillier"
+                            else "Sodium")
+            if keypair is not None and keypair.ek.variant != want_variant:
+                flag = (" --encryption paillier" if args.encryption == "paillier"
+                        else "")
+                print(f"error: recipient key {recipient_key} is a "
+                      f"{keypair.ek.variant} key but --encryption "
+                      f"{args.encryption} needs a {want_variant} key; run "
+                      f"`sda agent keys create{flag}` first", file=sys.stderr)
+                return 1
+            if (keypair is not None and args.encryption == "paillier"
+                    and keypair.ek.variant == "PackedPaillier"):
+                # variant alone isn't enough: a key below the scheme's
+                # modulus floor is rejected by PackedPaillierEncryptor at
+                # every later participation (encryption.py:84-88)
+                from .. import crypto as _crypto
+
+                key_bits = _crypto.paillier.PaillierPublicKey.from_bytes(
+                    keypair.ek.value.data).bitsize
+                if key_bits < args.paillier_modulus_bits:
+                    print(f"error: recipient key {recipient_key} is "
+                          f"{key_bits}-bit but the aggregation requires "
+                          f">= {args.paillier_modulus_bits}-bit keys; run "
+                          f"`sda agent keys create --encryption paillier "
+                          f"--paillier-modulus-bits "
+                          f"{args.paillier_modulus_bits}` first",
+                          file=sys.stderr)
+                    return 1
             aggregation = Aggregation(
                 id=AggregationId.random(),
                 title=args.title,
                 vector_dimension=args.dimension,
                 modulus=args.modulus,
                 recipient=client.agent.id,
-                recipient_key=_primary_key(client, store),
+                recipient_key=recipient_key,
                 masking_scheme=masking,
                 committee_sharing_scheme=sharing,
                 recipient_encryption_scheme=encryption_scheme,

@@ -45,7 +45,12 @@ def mod_combine(vectors: Sequence[np.ndarray], modulus: int) -> np.ndarray:
     vecs = [np.asarray(v, dtype=np.int64) for v in vectors]
     if not vecs:
         return np.zeros(0, dtype=np.int64)
-    stacked = np.stack(vecs)
+    # Canonicalize before summing: the overflow-exact chunking in modsum /
+    # np_modsum derives its fan from the modulus and assumes residues in
+    # [0, m). Fresh shares satisfy that, but Paillier-premixed clerk batches
+    # decrypt to UNREDUCED sums (encryption.py PackedPaillierDecryptor), and
+    # at wide component windows those could wrap an int64 partial sum.
+    stacked = np.stack(vecs) % modulus
     if _small(stacked.size):
         return oracle.combine(stacked, modulus)
     return np.asarray(fields.combine(jnp.asarray(stacked), modulus=modulus))

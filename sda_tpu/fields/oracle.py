@@ -42,7 +42,9 @@ def additive_share_from_randomness(secrets, draws, modulus: int) -> np.ndarray:
 
 
 def combine(shares, modulus: int) -> np.ndarray:
-    return np_modsum(np.asarray(shares, dtype=np.int64), modulus, axis=0)
+    # % first: np_modsum's overflow-exact fan assumes canonical residues,
+    # and callers may feed unreduced values (e.g. Paillier-premixed sums).
+    return np_modsum(np.asarray(shares, dtype=np.int64) % modulus, modulus, axis=0)
 
 
 def packed_share_from_randomness(secrets, randomness, scheme) -> np.ndarray:

@@ -125,6 +125,39 @@ def test_cli_paillier_aggregation(httpd, tmp_path, capsys):
     assert sda("recipient", "aggregations", "reveal", agg_id) == "11 22 33 44"
 
 
+def test_cli_paillier_errors_are_friendly(httpd, tmp_path, capsys):
+    """Misconfigured Paillier options exit 1 with an actionable message,
+    never a traceback (round-2 advisor findings)."""
+    url = httpd.address
+
+    def sda(identity, *args):
+        rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity), *args])
+        out = capsys.readouterr()
+        return rc, out.out.strip(), out.err
+
+    # keys create with a modulus too small for even one window: friendly error
+    rc, _, _ = sda("tiny", "agent", "create")
+    assert rc == 0
+    rc, _, err = sda("tiny", "agent", "keys", "create",
+                     "--encryption", "paillier", "--paillier-modulus-bits", "32")
+    assert rc == 1
+    assert "error:" in err and "--paillier-modulus-bits" in err
+
+    # aggregations create --encryption paillier over a Sodium primary key:
+    # caught at create time with a pointer to the fix, not at participation
+    rc, _, _ = sda("mismatched", "agent", "create")
+    assert rc == 0
+    rc, _, _ = sda("mismatched", "agent", "keys", "create")  # Sodium key
+    assert rc == 0
+    rc, _, err = sda(
+        "mismatched", "aggregations", "create", "bad-run",
+        "--dimension", "4", "--modulus", "433", "--shares", "3",
+        "--encryption", "paillier", "--paillier-modulus-bits", "512",
+    )
+    assert rc == 1
+    assert "Sodium" in err and "keys create --encryption paillier" in err
+
+
 def test_sim_cli_multihost(tmp_path, capsys):
     """`sda-sim --multihost 2` spawns two real worker processes over gRPC
     collectives and prints exactly one JSON result line (worker chatter
