@@ -190,7 +190,10 @@ def main() -> int:
 
             env = dict(os.environ, SDA_BENCH_PLATFORM="tpu",
                        SDA_PALLAS_PBLOCK=str(best["p_block"]),
-                       SDA_PALLAS_TILE=str(best["tile"]))
+                       SDA_PALLAS_TILE=str(best["tile"]),
+                       # full-coverage streamed e2e rounds (every dim tile,
+                       # finale included) in the same hardware window
+                       SDA_BENCH_FULL="1")
             r = subprocess.run(
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),

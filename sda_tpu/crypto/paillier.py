@@ -129,6 +129,21 @@ class PaillierSecretKey:
                    int.from_bytes(raw[4 + plen :], "big"))
 
 
+def _powmod(base: int, exp: int, mod: int) -> int:
+    """pow() with the native Montgomery ladder when it wins (odd moduli at
+    Paillier sizes — ~3-4x CPython's pow at 2048-bit keys, sda_native.cpp
+    sda_powmod); falls back to builtin pow silently."""
+    if exp >= 0 and (mod & 1) and mod.bit_length() >= 512:
+        from .. import native
+
+        if native.available():
+            try:
+                return native.powmod(base, exp, mod)
+            except (ValueError, RuntimeError):
+                pass
+    return pow(base, exp, mod)
+
+
 def keygen(modulus_bits: int) -> tuple[PaillierPublicKey, PaillierSecretKey]:
     """Fresh keypair with an exactly-``modulus_bits``-bit n."""
     half = modulus_bits // 2
@@ -151,7 +166,7 @@ def encrypt(pk: PaillierPublicKey, m: int, r: int | None = None) -> int:
             r = secrets.randbelow(n)
             if r and math.gcd(r, n) == 1:
                 break
-    return (1 + m * n) % n2 * pow(r, n, n2) % n2
+    return (1 + m * n) % n2 * _powmod(r, n, n2) % n2
 
 
 def add(pk: PaillierPublicKey, c1: int, c2: int) -> int:
@@ -165,8 +180,8 @@ def decrypt(sk: PaillierSecretKey, c: int) -> int:
     if not 0 <= c < n * n:
         raise ValueError("ciphertext out of range [0, n^2)")
     p2, q2, hp, hq, p_inv_q = sk._crt
-    mp = (pow(c % p2, p - 1, p2) - 1) // p * hp % p
-    mq = (pow(c % q2, q - 1, q2) - 1) // q * hq % q
+    mp = (_powmod(c % p2, p - 1, p2) - 1) // p * hp % p
+    mq = (_powmod(c % q2, q - 1, q2) - 1) // q * hq % q
     return mp + p * ((mq - mp) * p_inv_q % q)
 
 

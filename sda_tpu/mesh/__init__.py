@@ -13,4 +13,6 @@ from .streaming import (
     StreamingAggregator,
     array_block_provider,
     synthetic_block_provider,
+    synthetic_block_provider32,
+    synthetic_device_block_provider32,
 )

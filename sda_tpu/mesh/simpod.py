@@ -43,6 +43,7 @@ federated HTTP mode keeps sealed boxes.
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -251,6 +252,83 @@ def _share_sum_stage(scheme, f: FieldOps, M_host, masked, skey):
     return jnp.concatenate([dsum, last[None, :]], axis=0)
 
 
+def _pallas_supported(scheme, masking, f: FieldOps) -> bool:
+    """The fused kernel serves packed-Shamir over a Solinas prime with
+    None/Full masking (ChaCha masks must come from the versioned wire PRG,
+    which the kernel does not generate)."""
+    return (
+        isinstance(scheme, PackedShamirSharing)
+        and f.sp is not None
+        and isinstance(masking, (NoMasking, FullMasking))
+    )
+
+
+def _pallas_env_default() -> bool:
+    return os.environ.get("SDA_PALLAS") == "1"
+
+
+def _resolve_pallas(scheme, masking, f: FieldOps, use_pallas, what: str) -> bool:
+    """Shared constructor gating for the three aggregators: env default
+    (SDA_PALLAS=1) falls back to the XLA step silently on unsupported
+    configs; an EXPLICIT use_pallas=True raises instead."""
+    want = _pallas_env_default() if use_pallas is None else bool(use_pallas)
+    active = want and _pallas_supported(scheme, masking, f)
+    if use_pallas and not active:
+        raise ValueError(
+            f"pallas {what} step requires packed-Shamir over a Solinas "
+            f"prime with None/Full masking"
+        )
+    return active
+
+
+def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
+                  interpret: bool = False, external_bits_fn=None):
+    """[S, d_loc] canonical residues -> (combined shares [n, B0],
+    mask sum [d_loc] | None) on the fused Pallas kernel.
+
+    Drop-in replacement for the _mask_stage + _share_sum_stage pair in the
+    pod/streamed local steps (fused HBM pass: pallas_round.py). The round
+    result is exact for ANY mask/share randomness — masks cancel in the
+    final subtract and the random polynomial rows are annihilated by the
+    reconstruction matrix — so swapping the XLA threefry draws for the
+    kernel's on-core PRNG (or injected external bits) never changes the
+    aggregate; tests pin pallas-pod == xla-pod == plain sum.
+
+    ``external_bits_fn(key, S, draws, B)`` (tests/util.external_bits
+    layout) enables interpret-mode runs on CPU, where the TPU PRNG
+    primitive is unavailable.
+    """
+    from ..fields import pallas_round
+
+    S, d_loc = x.shape
+    k, t = scheme.secret_count, scheme.privacy_threshold
+    masked = isinstance(masking, FullMasking)
+    x_cols = sharing.batch_columns(x, k)                    # [S, k, B0]
+    B0 = x_cols.shape[-1]
+    p_block = int(os.environ.get("SDA_PALLAS_PBLOCK", 16))
+    env_tile = os.environ.get("SDA_PALLAS_TILE")
+    tile = int(env_tile) if env_tile else (
+        2048 if B0 >= 2048 else max(128, -(-B0 // 128) * 128)
+    )
+    pad = (-B0) % tile
+    if pad:  # padded columns are sliced off below; their shares never land
+        x_cols = jnp.pad(x_cols, ((0, 0), (0, 0), (0, pad)))
+    seed = jax.random.randint(dev_key, (), 0, np.int32(2**31 - 1),
+                              dtype=jnp.int32)
+    ext = None
+    if external_bits_fn is not None:
+        draws = (k + t) if masked else t
+        ext = external_bits_fn(dev_key, S, draws, B0 + pad)
+    shares, mask_tot = pallas_round.fused_mask_share_combine(
+        x_cols, seed, f.sp, M_host, t, masked,
+        tile=tile, external_bits=ext, interpret=interpret, p_block=p_block,
+    )
+    shares = shares[:, :B0]
+    if not masked:
+        return shares, None
+    return shares, sharing.unbatch_columns(mask_tot[:, :B0], d_loc)
+
+
 def _scan_combine(f: FieldOps, scheme, masking, M_host, x, key, round_key,
                   pid0, dblk0, chunk: int):
     """[P, d] canonical residues -> (acc_shares [n, B], acc_mask [d]|None).
@@ -315,7 +393,7 @@ def _dim_grain(scheme, masking) -> int:
     return grain
 
 
-def _build_matrices(scheme):
+def _build_matrices(scheme, survivors: Optional[Tuple[int, ...]] = None):
     if not isinstance(scheme, PackedShamirSharing):
         return None, None
     s = scheme
@@ -326,9 +404,43 @@ def _build_matrices(scheme):
     L = numtheory.packed_reconstruct_matrix(
         s.secret_count, s.share_count, s.privacy_threshold,
         s.prime_modulus, s.omega_secrets, s.omega_shares,
-        tuple(range(s.share_count)),
+        tuple(range(s.share_count)) if survivors is None else survivors,
     )
     return M, L
+
+
+def _normalize_survivors(scheme, surviving_clerks) -> Optional[Tuple[int, ...]]:
+    """Validate a clerk-dropout quorum for the mesh modes (SURVEY §2.4
+    fault-tolerant-quorum row; reference semantics crypto.rs:146-153).
+
+    The pod/streamed finale reconstructs from clerk ROWS; a lost device or
+    process loses the clerk rows it hosts, never the mask sums (masks
+    travel participant->recipient, not through clerks — receive.rs:102-118),
+    so dropping to a quorum of rows recovers the exact aggregate. Truncates
+    to exactly reconstruction_threshold rows so the finale has ONE compiled
+    shape per survivor count (the fixed-quorum design of
+    crypto/sharing.py::PackedShamirReconstructor).
+    """
+    if surviving_clerks is None:
+        return None
+    survivors = tuple(int(i) for i in surviving_clerks)
+    n = scheme.output_size
+    if any(i < 0 or i >= n for i in survivors) or len(set(survivors)) != len(survivors):
+        raise ValueError(f"surviving clerks {survivors} must be distinct in [0, {n})")
+    if not isinstance(scheme, PackedShamirSharing):
+        if len(survivors) < n:
+            raise ValueError(
+                "additive sharing needs every clerk row; clerk dropout "
+                "requires packed Shamir (crypto.rs:146-153)"
+            )
+        return None  # all rows = the normal finale
+    r = scheme.reconstruction_threshold
+    if len(survivors) < r:
+        raise ValueError(
+            f"need at least reconstruction_threshold={r} surviving clerks, "
+            f"got {len(survivors)}"
+        )
+    return survivors[:r]
 
 
 class SimulatedPod:
@@ -345,6 +457,10 @@ class SimulatedPod:
         masking_scheme: Optional[LinearMaskingScheme] = None,
         mesh: Optional[Mesh] = None,
         scan_chunk: int = 8,
+        use_pallas: Optional[bool] = None,
+        pallas_interpret: bool = False,
+        pallas_external_bits_fn=None,
+        surviving_clerks=None,
     ):
         self.scan_chunk = int(scan_chunk)
         self.scheme = sharing_scheme
@@ -352,6 +468,8 @@ class SimulatedPod:
         self.masking = masking_scheme or NoMasking()
         _check_masking_supported(self.masking)
         _check_mask_modulus(self.masking, sharing_scheme)
+        self._pallas_interpret = bool(pallas_interpret)
+        self._pallas_bits_fn = pallas_external_bits_fn
         if mesh is None:
             p_shards, d_shards = default_mesh_shape(
                 len(jax.devices()), sharing_scheme.output_size
@@ -364,10 +482,18 @@ class SimulatedPod:
                 f"committee size {sharing_scheme.output_size} must be divisible "
                 f"by the p axis ({p_shards})"
             )
-        self._M_host, self._L_host = _build_matrices(sharing_scheme)
+        self.surviving_clerks = _normalize_survivors(
+            sharing_scheme, surviving_clerks
+        )
+        self._M_host, self._L_host = _build_matrices(
+            sharing_scheme, self.surviving_clerks
+        )
         # cross-shard share/mask sums ride collectives between canonicalizes
         self._field = FieldOps.create(self.modulus, cross_terms=p_shards)
         _check_collective_headroom(self._field, p_shards)
+        self.pallas_active = _resolve_pallas(
+            sharing_scheme, self.masking, self._field, use_pallas, "local"
+        )
         self._step = None
         self._step_shape = None
 
@@ -389,13 +515,21 @@ class SimulatedPod:
         dev_key = _tile_key(key, pi, di)
 
         x = f.to_residues(inputs)
-        # participant parallelism -> local scan-chunked reduction (share
-        # tensor stays [chunk, n, B_loc], never [P_loc, n, B_loc])
-        local_sum, local_mask_sum = _scan_combine(
-            f, self.scheme, self.masking, self._M_host, x, dev_key, key,
-            pid0=pi * P_loc, dblk0=di * (d_loc // 8),
-            chunk=self.scan_chunk,
-        )                                                          # [n, B_loc]
+        if self.pallas_active:
+            # fused mask+share+combine in one HBM pass (pallas_round.py)
+            local_sum, local_mask_sum = _pallas_stage(
+                self.scheme, f, self._M_host, self.masking, x, dev_key,
+                interpret=self._pallas_interpret,
+                external_bits_fn=self._pallas_bits_fn,
+            )                                                      # [n, B_loc]
+        else:
+            # participant parallelism -> local scan-chunked reduction (share
+            # tensor stays [chunk, n, B_loc], never [P_loc, n, B_loc])
+            local_sum, local_mask_sum = _scan_combine(
+                f, self.scheme, self.masking, self._M_host, x, dev_key, key,
+                pid0=pi * P_loc, dblk0=di * (d_loc // 8),
+                chunk=self.scan_chunk,
+            )                                                      # [n, B_loc]
 
         # snapshot transpose + clerk combine == one psum_scatter over ICI:
         # clerk axis is split across 'p' while partial sums are combined
@@ -407,6 +541,10 @@ class SimulatedPod:
         # recipient gathers all clerk rows (clerk -> recipient leg)
         gathered = jax.lax.all_gather(clerk_rows, "p", axis=0, tiled=True)
 
+        if self.surviving_clerks is not None:
+            # clerk dropout: reveal from the quorum's rows only — lost
+            # rows (dead device/process) never enter the reconstruct
+            gathered = gathered[jnp.asarray(self.surviving_clerks), :]
         masked_total = _reconstruct_stage(
             self.scheme, f, self._L_host, gathered, d_loc
         )                                                          # [d_loc]

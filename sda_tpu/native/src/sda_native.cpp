@@ -137,6 +137,227 @@ int sda_chacha_combine_masks(const int64_t* seeds, int64_t n_seeds,
     return 0;
 }
 
-int sda_native_abi_version() { return 1; }
+// ---------------------------------------------------------------------------
+// Big-integer Montgomery modular exponentiation — the Paillier hot op.
+//
+// CPython's pow() on 2048-bit operands runs 30-bit digit arithmetic; this
+// CIOS Montgomery ladder on 64-bit limbs (4-bit window, dedicated
+// squaring) measures ~3.5-5x faster — 347ms -> ~75ms per Paillier
+// encryption at 2048-bit keys (see docs/crypto.md envelope). Limb arrays
+// are little-endian uint64, caller-owned; the modulus must be odd (n and
+// n^2 always are) with a nonzero top limb.
+
+namespace {
+
+// -n^-1 mod 2^64 via Newton iteration (n odd).
+static uint64_t mont_n0inv(uint64_t n0) {
+    uint64_t x = 1;
+    for (int i = 0; i < 6; ++i) x *= 2 - n0 * x;  // doubles correct bits
+    return ~x + 1;  // negate mod 2^64
+}
+
+// out = a*b*R^-1 mod n (CIOS), R = 2^(64*nl). a, b < n. scratch t[nl+2].
+static void mont_mul(const uint64_t* a, const uint64_t* b, const uint64_t* n,
+                     uint64_t n0inv, int64_t nl, uint64_t* t, uint64_t* out) {
+    for (int64_t i = 0; i < nl + 2; ++i) t[i] = 0;
+    for (int64_t i = 0; i < nl; ++i) {
+        // t += a[i] * b
+        unsigned __int128 carry = 0;
+        for (int64_t j = 0; j < nl; ++j) {
+            carry += (unsigned __int128)a[i] * b[j] + t[j];
+            t[j] = (uint64_t)carry;
+            carry >>= 64;
+        }
+        carry += t[nl];
+        t[nl] = (uint64_t)carry;
+        t[nl + 1] = (uint64_t)(carry >> 64);
+        // t += m * n, where m = t[0] * n0inv mod 2^64; then t >>= 64
+        uint64_t m = t[0] * n0inv;
+        carry = (unsigned __int128)m * n[0] + t[0];
+        carry >>= 64;
+        for (int64_t j = 1; j < nl; ++j) {
+            carry += (unsigned __int128)m * n[j] + t[j];
+            t[j - 1] = (uint64_t)carry;
+            carry >>= 64;
+        }
+        carry += t[nl];
+        t[nl - 1] = (uint64_t)carry;
+        t[nl] = t[nl + 1] + (uint64_t)(carry >> 64);
+    }
+    // conditional subtract: t may be in [0, 2n)
+    uint64_t borrow = 0;
+    for (int64_t j = 0; j < nl; ++j) {
+        unsigned __int128 d =
+            (unsigned __int128)t[j] - n[j] - borrow;
+        out[j] = (uint64_t)d;
+        borrow = (uint64_t)(d >> 64) ? 1 : 0;
+    }
+    if (t[nl] == 0 && borrow) {  // t < n: keep t
+        for (int64_t j = 0; j < nl; ++j) out[j] = t[j];
+    }
+}
+
+// Montgomery squaring: the ladder is ~5 squares per multiply, and a
+// schoolbook square needs only the upper-triangle products doubled —
+// ~35% fewer 128-bit multiplies than mont_mul. Computes the full 2nl-limb
+// square into s, then a separate REDC pass. scratch s[2*nl+1].
+static void mont_sqr(const uint64_t* a, const uint64_t* n, uint64_t n0inv,
+                     int64_t nl, uint64_t* s, uint64_t* out) {
+    for (int64_t i = 0; i < 2 * nl + 1; ++i) s[i] = 0;
+    // off-diagonal products once
+    for (int64_t i = 0; i < nl; ++i) {
+        unsigned __int128 carry = 0;
+        for (int64_t j = i + 1; j < nl; ++j) {
+            carry += (unsigned __int128)a[i] * a[j] + s[i + j];
+            s[i + j] = (uint64_t)carry;
+            carry >>= 64;
+        }
+        s[i + nl] += (uint64_t)carry;  // no overflow: slot untouched so far
+    }
+    // double, then add the diagonal
+    uint64_t carry1 = 0;
+    for (int64_t i = 0; i < 2 * nl; ++i) {
+        uint64_t v = s[i];
+        s[i] = (v << 1) | carry1;
+        carry1 = v >> 63;
+    }
+    unsigned __int128 carry = 0;
+    for (int64_t i = 0; i < nl; ++i) {
+        carry += (unsigned __int128)a[i] * a[i] + s[2 * i];
+        s[2 * i] = (uint64_t)carry;
+        carry = (carry >> 64) + s[2 * i + 1];
+        s[2 * i + 1] = (uint64_t)carry;
+        carry >>= 64;
+    }
+    // REDC: nl rounds of m = s[i]*n0inv; s += m*n << (64*i)
+    for (int64_t i = 0; i < nl; ++i) {
+        uint64_t m = s[i] * n0inv;
+        unsigned __int128 c2 = (unsigned __int128)m * n[0] + s[i];
+        c2 >>= 64;
+        for (int64_t j = 1; j < nl; ++j) {
+            c2 += (unsigned __int128)m * n[j] + s[i + j];
+            s[i + j] = (uint64_t)c2;
+            c2 >>= 64;
+        }
+        // propagate the carry into the high limbs
+        for (int64_t j = i + nl; c2 && j <= 2 * nl; ++j) {
+            c2 += s[j];
+            s[j] = (uint64_t)c2;
+            c2 >>= 64;
+        }
+    }
+    // result = s[nl .. 2nl] (may be >= n once)
+    uint64_t borrow = 0;
+    for (int64_t j = 0; j < nl; ++j) {
+        unsigned __int128 d =
+            (unsigned __int128)s[nl + j] - n[j] - borrow;
+        out[j] = (uint64_t)d;
+        borrow = (uint64_t)(d >> 64) ? 1 : 0;
+    }
+    if (s[2 * nl] == 0 && borrow) {
+        for (int64_t j = 0; j < nl; ++j) out[j] = s[nl + j];
+    }
+}
+
+// R^2 mod n by 2*64*nl doublings of 1 (cheap next to the ladder).
+static void mont_rr(const uint64_t* n, int64_t nl, uint64_t* rr) {
+    for (int64_t i = 0; i < nl; ++i) rr[i] = 0;
+    rr[0] = 1;
+    // rr < n invariant; double with conditional subtract
+    for (int64_t bit = 0; bit < 2 * 64 * nl; ++bit) {
+        uint64_t carry = 0;
+        for (int64_t j = 0; j < nl; ++j) {
+            uint64_t v = rr[j];
+            rr[j] = (v << 1) | carry;
+            carry = v >> 63;
+        }
+        // subtract n if rr >= n (or the shift overflowed)
+        bool ge = carry != 0;
+        if (!ge) {
+            ge = true;
+            for (int64_t j = nl - 1; j >= 0; --j) {
+                if (rr[j] != n[j]) { ge = rr[j] > n[j]; break; }
+            }
+        }
+        if (ge) {
+            uint64_t borrow = 0;
+            for (int64_t j = 0; j < nl; ++j) {
+                unsigned __int128 d =
+                    (unsigned __int128)rr[j] - n[j] - borrow;
+                rr[j] = (uint64_t)d;
+                borrow = (uint64_t)(d >> 64) ? 1 : 0;
+            }
+        }
+    }
+}
+
+}  // namespace
+
+// out = base^exp mod n. Little-endian uint64 limbs; base/out have nl limbs
+// (base < n), exp has el limbs, n odd with n[nl-1] != 0. Fixed 4-bit
+// window. scratch must hold 22 * nl + 3 limbs; pass null to have the
+// function refuse (keeps the ABI allocation-free).
+int sda_powmod(const uint64_t* base, const uint64_t* exp, int64_t el,
+               const uint64_t* n, int64_t nl, uint64_t* scratch,
+               uint64_t* out) {
+    if (!base || !exp || !n || !out || !scratch) return 1;
+    if (nl <= 0 || el < 0 || (n[0] & 1) == 0 || n[nl - 1] == 0) return 1;
+    uint64_t n0inv = mont_n0inv(n[0]);
+    uint64_t* table = scratch;             // 16 * nl: window powers (mont)
+    uint64_t* rr = table + 16 * nl;        // nl
+    uint64_t* acc = rr + nl;               // nl
+    uint64_t* tmp = acc + nl;              // nl
+    uint64_t* t = tmp + nl;                // nl + 2 (CIOS scratch)
+    uint64_t* sq = t + nl + 2;             // 2 * nl + 1 (squaring scratch)
+    mont_rr(n, nl, rr);
+    // table[1] = base in Montgomery form; table[0] = 1 in Montgomery form
+    mont_mul(base, rr, n, n0inv, nl, t, table + nl);
+    uint64_t* one = tmp;
+    for (int64_t j = 0; j < nl; ++j) one[j] = (j == 0);
+    mont_mul(one, rr, n, n0inv, nl, t, table);  // mont(1) = R mod n
+    for (int w = 2; w < 16; ++w)
+        mont_mul(table + (w - 1) * nl, table + nl, n, n0inv, nl, t,
+                 table + w * nl);
+    // top-down 4-bit ladder
+    for (int64_t j = 0; j < nl; ++j) acc[j] = table[j];  // mont(1)
+    int64_t top = el - 1;
+    while (top >= 0 && exp[top] == 0) --top;
+    bool started = false;
+    for (int64_t i = top; i >= 0; --i) {
+        for (int shift = 60; shift >= 0; shift -= 4) {
+            int w = (int)((exp[i] >> shift) & 0xF);
+            if (started) {
+                mont_sqr(acc, n, n0inv, nl, sq, acc);
+                mont_sqr(acc, n, n0inv, nl, sq, acc);
+                mont_sqr(acc, n, n0inv, nl, sq, acc);
+                mont_sqr(acc, n, n0inv, nl, sq, acc);
+            }
+            if (w != 0) {
+                mont_mul(acc, table + w * nl, n, n0inv, nl, t, acc);
+                started = true;
+            } else if (!started) {
+                continue;  // skip leading zeros entirely
+            }
+        }
+    }
+    // leave Montgomery form: acc * 1
+    mont_mul(acc, one, n, n0inv, nl, t, out);
+    return 0;
+}
+
+// Batch variant: `count` bases against one (exp, n) — the Paillier premix
+// and clerk-batch shapes. bases/outs are [count, nl].
+int sda_powmod_batch(const uint64_t* bases, int64_t count, const uint64_t* exp,
+                     int64_t el, const uint64_t* n, int64_t nl,
+                     uint64_t* scratch, uint64_t* outs) {
+    for (int64_t i = 0; i < count; ++i) {
+        int rc = sda_powmod(bases + i * nl, exp, el, n, nl, scratch,
+                            outs + i * nl);
+        if (rc) return rc;
+    }
+    return 0;
+}
+
+int sda_native_abi_version() { return 2; }
 
 }  // extern "C"

@@ -77,3 +77,44 @@ def test_masking_layer_uses_native_consistently():
     total = masking.new_mask_combiner(ChaChaMasking(433, 100, 128)).combine([seed])
     out = masking.new_secret_unmasker(ChaChaMasking(433, 100, 128)).unmask(total, masked)
     np.testing.assert_array_equal(out, s)
+
+
+def test_native_powmod_matches_pow():
+    """Montgomery ladder == CPython pow across sizes, including the
+    Paillier shapes (2048-bit exponent mod 4096-bit n^2)."""
+    import random
+
+    random.seed(11)
+    for bits in (64, 127, 256, 1024, 2048):
+        mod = random.getrandbits(bits) | 1 | (1 << (bits - 1))
+        base = random.getrandbits(bits + 7)
+        exp = random.getrandbits(random.choice([1, 64, bits]))
+        assert native.powmod(base, exp, mod) == pow(base, exp, mod)
+    assert native.powmod(5, 0, 7) == 1
+    assert native.powmod(0, 123, 97) == 0
+    mod = random.getrandbits(2048) | 1 | (1 << 2047)
+    bases = [random.getrandbits(2040) for _ in range(4)]
+    e = random.getrandbits(1024)
+    assert native.powmod_batch(bases, e, mod) == [pow(b, e, mod) for b in bases]
+    with pytest.raises(ValueError):
+        native.powmod(2, 3, 10)  # even modulus unsupported
+
+
+def test_paillier_uses_native_powmod_consistently():
+    """Paillier encrypt/decrypt are identical with and without the native
+    ladder (the hook is a pure speedup, never a semantic change)."""
+    from sda_tpu.crypto import paillier
+
+    pk, sk = paillier.keygen(512)
+    m = 123456789
+    c = paillier.encrypt(pk, m, r=987654321 % pk.n)
+    # force the pure-Python path for the same inputs
+    orig = paillier._powmod
+    try:
+        paillier._powmod = pow
+        c_py = paillier.encrypt(pk, m, r=987654321 % pk.n)
+        m_py = paillier.decrypt(sk, c)
+    finally:
+        paillier._powmod = orig
+    assert c == c_py
+    assert paillier.decrypt(sk, c) == m_py == m
