@@ -186,6 +186,63 @@ def main() -> int:
                           error=f"{type(e).__name__}: {str(e)[:200]}")
         if best is not None:
             _emit("sweep_best", **best)
+            # streamed-step A/B on chip (round-2 verdict #4 'done'
+            # criterion): the same device-resident chunk loop with the
+            # Pallas local stage vs the XLA stage — committed evidence for
+            # whether the kernel's win carries into the streamed mode
+            os.environ["SDA_PALLAS_PBLOCK"] = str(best["p_block"])
+            os.environ["SDA_PALLAS_TILE"] = str(best["tile"])
+            try:
+                from sda_tpu.mesh import (
+                    StreamingAggregator,
+                    synthetic_block_provider32,
+                    synthetic_device_block_provider32,
+                )
+
+                dc, pc = 3 * (1 << 19), 64
+                prov = synthetic_block_provider32(p, seed=3, max_value=1 << 20)
+                # timing blocks generated ON DEVICE (bit-identical twin
+                # generator): ~1.6 GB of H2D through the flaky tunnel could
+                # burn the window before the suite re-record runs
+                prov_dev = synthetic_device_block_provider32(
+                    p, seed=3, max_value=1 << 20)
+                blocks = [jnp.asarray(prov_dev(i * pc, (i + 1) * pc, 0, dc))
+                          for i in range(4)]
+                jax.block_until_ready(blocks)
+                expected_ab = (prov(0, pc, 0, 4096).astype(np.int64)
+                               .sum(axis=0) % p)
+                for use_p in (False, True):
+                    agg = StreamingAggregator(
+                        scheme, FullMasking(p), participants_chunk=pc,
+                        dim_chunk=dc, use_pallas=use_p,
+                    )
+                    sub = agg.aggregate_blocks(prov, pc, 4096, key)
+                    ab_exact = bool(np.array_equal(sub[:4096], expected_ab))
+                    step = agg._step_fn((pc, dc))
+                    B = dc // scheme.secret_count
+                    accs = [jnp.zeros((scheme.share_count, B), jnp.uint32),
+                            jnp.zeros((dc,), jnp.uint32)]
+                    state = {"a": accs, "i": 0}
+
+                    def disp(_):
+                        state["a"] = list(step(
+                            blocks[state["i"] % 4],
+                            jax.random.fold_in(key, state["i"]), key,
+                            jnp.int32(state["i"] * pc), jnp.int32(0),
+                            *state["a"],
+                        ))
+                        state["i"] += 1
+                        return state["a"][0]
+
+                    jax.device_get(jnp.ravel(disp(0))[0])  # warm/compile
+                    per, _i2 = marginal_seconds(disp, target_seconds=5)
+                    _emit("streamed_ab", pallas=use_p, ok=ab_exact,
+                          chunk_ms=round(per * 1000, 2),
+                          gel_per_sec=round(pc * dc / per / 1e9, 2))
+                    ok = ok and ab_exact
+            except Exception as e:
+                _emit("streamed_ab", ok=False,
+                      error=f"{type(e).__name__}: {str(e)[:300]}")
             import subprocess
 
             env = dict(os.environ, SDA_BENCH_PLATFORM="tpu",

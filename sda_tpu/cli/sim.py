@@ -33,6 +33,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--streaming", action="store_true",
                         help="chunked single-chip rounds (HBM-exceeding sizes)")
     parser.add_argument("--participants-chunk", type=int, default=64)
+    parser.add_argument("--pallas", action="store_true",
+                        help="fused Pallas local step (packed-Shamir x "
+                             "Solinas x none/full masking; TPU)")
+    parser.add_argument("--drop-clerks", type=str, metavar="I,J,...",
+                        default=None,
+                        help="simulate losing these clerk indices: the "
+                             "finale reveals from the surviving quorum only")
     parser.add_argument("--multihost", type=int, metavar="N", default=0,
                         help="spawn N OS processes (gRPC collectives); each "
                              "owns 1/N of the participants and devices")
@@ -160,6 +167,46 @@ def main(argv=None) -> int:
     k = args.secrets_per_batch
     t, p, w2, w3 = numtheory.generate_packed_params(k, args.clerks, args.modulus_bits)
     scheme = PackedShamirSharing(k, args.clerks, t, p, w2, w3)
+    survivors = None
+    if args.drop_clerks:
+        try:
+            dropped = {int(i) for i in args.drop_clerks.split(",")}
+        except ValueError:
+            print(f"error: --drop-clerks expects comma-separated indices, "
+                  f"got {args.drop_clerks!r}", file=sys.stderr)
+            return 1
+        bad = sorted(i for i in dropped if not 0 <= i < args.clerks)
+        if bad:
+            print(f"error: --drop-clerks indices {bad} outside the "
+                  f"committee [0, {args.clerks})", file=sys.stderr)
+            return 1
+        survivors = tuple(i for i in range(args.clerks) if i not in dropped)
+        r = scheme.reconstruction_threshold
+        if len(survivors) < r:
+            print(f"error: dropping {sorted(dropped)} leaves "
+                  f"{len(survivors)} clerks, below the reconstruction "
+                  f"threshold {r}", file=sys.stderr)
+            return 1
+    pod_kwargs = {"surviving_clerks": survivors}
+    if args.pallas:
+        if jax.devices()[0].platform == "cpu":
+            print("error: --pallas needs the TPU backend; this run fell "
+                  "back to CPU (tunnel down or SDA_SIM_PLATFORM=cpu)",
+                  file=sys.stderr)
+            return 1
+        if args.mask == "chacha":
+            print("error: --pallas supports none/full masking only (ChaCha "
+                  "masks come from the versioned wire PRG, which the fused "
+                  "kernel does not generate)", file=sys.stderr)
+            return 1
+        from ..fields.fastfield import SolinasPrime
+
+        if SolinasPrime.try_from(p) is None:
+            print(f"error: --pallas requires a Solinas-form prime; the "
+                  f"generated prime {p} is not (try a different "
+                  f"--modulus-bits)", file=sys.stderr)
+            return 1
+        pod_kwargs["use_pallas"] = True
     dim = args.dim  # both execution paths auto-pad to the scheme grain
     masking = {
         "none": NoMasking(),
@@ -192,6 +239,7 @@ def main(argv=None) -> int:
                 scheme, masking, mesh=mesh,
                 participants_chunk=args.participants_chunk,
                 dim_chunk=min(dim, 3 * (1 << 19)),
+                **pod_kwargs,
             )
             start = time.perf_counter()
             out = mh.streamed_aggregate_process_local(
@@ -201,7 +249,7 @@ def main(argv=None) -> int:
             elapsed = time.perf_counter() - start
             mode = f"multihost x{nproc} streamed mesh {mesh.devices.shape}"
         else:
-            pod = SimulatedPod(scheme, masking, mesh=mesh)
+            pod = SimulatedPod(scheme, masking, mesh=mesh, **pod_kwargs)
             out = np.asarray(mh.aggregate_process_local(pod, local, key=key))
             start = time.perf_counter()
             out = np.asarray(mh.aggregate_process_local(pod, local, key=key))
@@ -212,13 +260,14 @@ def main(argv=None) -> int:
             scheme, masking,
             participants_chunk=args.participants_chunk,
             dim_chunk=min(dim, 3 * (1 << 19)),
+            **pod_kwargs,
         )
         start = time.perf_counter()
         out = np.asarray(agg.aggregate(inputs, key=key))
         elapsed = time.perf_counter() - start
         mode = "streaming"
     else:
-        pod = SimulatedPod(scheme, masking)  # auto-pads to the mesh grain
+        pod = SimulatedPod(scheme, masking, **pod_kwargs)  # auto-pads to the mesh grain
         out = np.asarray(pod.aggregate(inputs, key=key))  # includes compile
         start = time.perf_counter()
         out = np.asarray(pod.aggregate(inputs, key=key))
@@ -232,6 +281,9 @@ def main(argv=None) -> int:
         "clerks": args.clerks,
         "prime": p,
         "fast_path": bool(getattr(agg if args.streaming else pod, "_sp", None)),
+        "pallas": bool(getattr(agg if args.streaming else pod, "pallas_active", False)),
+        "dropped_clerks": (sorted(set(range(args.clerks)) - set(survivors))
+                           if survivors else []),
         "seconds": round(elapsed, 4),
         "elements_per_sec": round(args.participants * dim / elapsed, 1),
     }

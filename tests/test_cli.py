@@ -158,6 +158,32 @@ def test_cli_paillier_errors_are_friendly(httpd, tmp_path, capsys):
     assert "Sodium" in err and "keys create --encryption paillier" in err
 
 
+def test_sim_cli_clerk_dropout(capsys, monkeypatch):
+    """`sda-sim --drop-clerks`: the finale reveals exactly from the
+    surviving quorum; below-quorum drops fail fast with a clear error."""
+    import json
+
+    from sda_tpu.cli import sim
+
+    # skip the TPU probe: conftest already pinned the CPU backend
+    monkeypatch.setenv("SDA_SIM_PLATFORM", "cpu")
+
+    rc = sim.main([
+        "--participants", "8", "--dim", "99", "--clerks", "8",
+        "--drop-clerks", "6", "--verify",
+    ])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert result["exact"] is True and result["dropped_clerks"] == [6]
+
+    rc = sim.main([
+        "--participants", "8", "--dim", "99", "--clerks", "8",
+        "--drop-clerks", "0,1,2,3,4",
+    ])
+    assert rc == 1
+    assert "below the reconstruction threshold" in capsys.readouterr().err
+
+
 def test_sim_cli_multihost(tmp_path, capsys):
     """`sda-sim --multihost 2` spawns two real worker processes over gRPC
     collectives and prints exactly one JSON result line (worker chatter
