@@ -167,6 +167,39 @@ def test_clerk_dropout_validation():
     assert agg.surviving_clerks is None
 
 
+@needs_devices(8)
+def test_pod_26_clerk_committee_with_dropout():
+    """The next committee size up (3^3-1 = 26 clerks) on a (2, 4) mesh —
+    13 clerk rows per p-shard — with 19 of 26 clerks dropped: the quorum
+    of 7 still reveals exactly."""
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 26, 28)
+    s = PackedShamirSharing(3, 26, t, p, w2, w3)
+    assert s.reconstruction_threshold == 7
+    pod = SimulatedPod(
+        s, masking_scheme=FullMasking(p), mesh=make_mesh(2, 4),
+        surviving_clerks=(25, 0, 3, 7, 12, 18, 21),
+    )
+    rng = np.random.default_rng(9)
+    inputs = rng.integers(0, 1 << 20, size=(8, 48))
+    out = np.asarray(pod.aggregate(inputs))
+    np.testing.assert_array_equal(out, inputs.sum(axis=0) % p)
+
+
+@needs_devices(8)
+def test_streamed_pod_chacha_with_dropout():
+    """ChaCha masking composes with clerk dropout: mask seeds travel
+    participant->recipient, so losing clerk rows loses no mask data."""
+    spod = StreamedPod(
+        GOLDEN, ChaChaMasking(433, 48, 128), mesh=make_mesh(4, 2),
+        participants_chunk=8, dim_chunk=24,
+        surviving_clerks=(0, 1, 2, 3, 4, 5, 6),
+    )
+    rng = np.random.default_rng(10)
+    inputs = rng.integers(0, 433, size=(11, 48))
+    out = np.asarray(spod.aggregate(inputs, jax.random.PRNGKey(6)))
+    np.testing.assert_array_equal(out, inputs.sum(axis=0) % 433)
+
+
 def test_pallas_env_default(monkeypatch):
     s = fast_scheme()
     monkeypatch.setenv("SDA_PALLAS", "1")
