@@ -263,11 +263,103 @@ class StreamingAggregator:
 
         return jax.jit(final, donate_argnums=(0, 1))
 
+    # -- checkpoint/resume -----------------------------------------------
+    # The reference is durable-by-construction (every protocol object is a
+    # store row the moment it exists, SURVEY §5.4); a flagship streamed
+    # round is minutes of accumulate steps, so the TPU-native mode gets
+    # the same property: the driver can persist (completed output prefix,
+    # in-flight accumulators, tile cursor) and resume mid-round. Tile keys
+    # are a pure function of (round key, tile indices), so a resumed run
+    # draws identical masks/shares and the result is bit-identical to an
+    # uninterrupted one.
+
+    def _checkpoint_fingerprint(self, participants, dimension, key):
+        import hashlib
+
+        from ..protocol.helpers import canonical_json
+
+        payload = {
+            "scheme": self.scheme.to_obj(),
+            "masking": self.masking.to_obj(),
+            "participants": int(participants),
+            "dimension": int(dimension),
+            "participants_chunk": self.participants_chunk,
+            "dim_chunk": self.dim_chunk,
+            "pallas": bool(self.pallas_active),
+            "survivors": self.surviving_clerks,
+            "key": np.asarray(
+                jax.random.key_data(key) if jnp.issubdtype(
+                    getattr(key, "dtype", None), jax.dtypes.prng_key)
+                else key).tolist(),
+        }
+        return hashlib.sha256(canonical_json(payload)).hexdigest()
+
+    @staticmethod
+    def _checkpoint_save(path, fingerprint, out, done_dims, di, pi,
+                         acc_shares, acc_mask):
+        """Atomic snapshot: npz to a temp file, then rename."""
+        import os
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(
+                    f, fingerprint=np.frombuffer(
+                        fingerprint.encode(), dtype=np.uint8),
+                    out=out[:done_dims], done_dims=np.int64(done_dims),
+                    di=np.int64(di), pi=np.int64(pi),
+                    acc_shares=np.asarray(acc_shares),
+                    acc_mask=np.asarray(acc_mask),
+                )
+                # crash-durable: data must reach stable storage BEFORE the
+                # rename lands, or a power loss leaves a truncated snapshot
+                # at the destination path
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    @staticmethod
+    def _checkpoint_load(path, fingerprint):
+        import os
+        import zipfile
+
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path) as z:
+                if bytes(z["fingerprint"]).decode() != fingerprint:
+                    return None  # different round/config: start fresh
+                return {k: z[k] for k in
+                        ("out", "done_dims", "di", "pi",
+                         "acc_shares", "acc_mask")}
+        except (OSError, ValueError, KeyError, EOFError,
+                zipfile.BadZipFile):
+            return None  # unreadable/truncated snapshot: start fresh
+
     # -- driver ----------------------------------------------------------
     def aggregate_blocks(
-        self, get_block: BlockProvider, participants: int, dimension: int, key=None
+        self, get_block: BlockProvider, participants: int, dimension: int,
+        key=None, *, checkpoint_path: Optional[str] = None,
+        checkpoint_every_chunks: int = 16,
     ) -> np.ndarray:
-        """Stream all blocks; returns the [dimension] aggregate (host array)."""
+        """Stream all blocks; returns the [dimension] aggregate (host array).
+
+        ``checkpoint_path``: persist an atomic, fsync'd resume snapshot
+        there every ``checkpoint_every_chunks`` participant chunks (0 =
+        only at dim-tile boundaries) and at every dim-tile boundary; an
+        existing snapshot for the identical round (scheme, shape,
+        chunking, key — sha256 fingerprint) resumes where it left off,
+        bit-identically. A snapshot from a different round, or a damaged
+        one, is ignored, never trusted.
+        """
         s = self.scheme
         if key is None:
             from ..crypto.core import fresh_prng_key
@@ -275,14 +367,35 @@ class StreamingAggregator:
             key = fresh_prng_key()
         acc_dtype = self._field.dtype
         out = np.empty(dimension, dtype=np.int64)
+        resume = None
+        fingerprint = None
+        if checkpoint_path is not None:
+            fingerprint = self._checkpoint_fingerprint(
+                participants, dimension, key)
+            resume = self._checkpoint_load(checkpoint_path, fingerprint)
+            if resume is not None:
+                nd = int(resume["done_dims"])
+                out[:nd] = resume["out"]
+        resume_di = int(resume["di"]) if resume is not None else -1
+        resume_pi = int(resume["pi"]) if resume is not None else 0
         for di, d0 in enumerate(range(0, dimension, self.dim_chunk)):
             d1 = min(d0 + self.dim_chunk, dimension)
             d_size = d1 - d0
             ds_pad = -(-d_size // self._grain) * self._grain  # edge tile
             B = ds_pad // s.input_size
-            acc_shares = jnp.zeros((s.output_size, B), acc_dtype)
-            acc_mask = jnp.zeros((ds_pad,), acc_dtype)
+            if resume is not None and di < resume_di:
+                continue  # completed tile: out[:done_dims] already restored
+            if resume is not None and di == resume_di and resume_pi > 0:
+                acc_shares = jnp.asarray(resume["acc_shares"])
+                acc_mask = jnp.asarray(resume["acc_mask"])
+                start_pi = resume_pi
+            else:
+                acc_shares = jnp.zeros((s.output_size, B), acc_dtype)
+                acc_mask = jnp.zeros((ds_pad,), acc_dtype)
+                start_pi = 0
             for pi, p0 in enumerate(range(0, participants, self.participants_chunk)):
+                if pi < start_pi:
+                    continue  # chunk already folded into the snapshot accs
                 p1 = min(p0 + self.participants_chunk, participants)
                 with timed_phase("stream.feed"):
                     raw = get_block(p0, p1, d0, d1)
@@ -307,6 +420,14 @@ class StreamingAggregator:
                         block, bkey, key, jnp.int32(p0), jnp.int32(d0 // 8),
                         acc_shares, acc_mask,
                     )
+                if (checkpoint_path is not None
+                        and checkpoint_every_chunks > 0
+                        and (pi + 1) % checkpoint_every_chunks == 0):
+                    with timed_phase("stream.checkpoint"):
+                        self._checkpoint_save(
+                            checkpoint_path, fingerprint, out, d0, di, pi + 1,
+                            np.asarray(acc_shares), np.asarray(acc_mask),
+                        )
             # sync before the finale so stream.finale times the collective
             # reconstruct alone, not the queued accumulate backlog
             with timed_phase("stream.steps_sync"):
@@ -316,6 +437,19 @@ class StreamingAggregator:
                 final = self._finals[ds_pad] = self._final_fn(ds_pad)
             with timed_phase("stream.finale"):
                 out[d0:d1] = np.asarray(final(acc_shares, acc_mask))[:d_size]
+            if checkpoint_path is not None:
+                with timed_phase("stream.checkpoint"):
+                    self._checkpoint_save(
+                        checkpoint_path, fingerprint, out, d1, di + 1, 0,
+                        np.zeros((0,), acc_dtype), np.zeros((0,), acc_dtype),
+                    )
+        if checkpoint_path is not None:
+            import os
+
+            try:
+                os.unlink(checkpoint_path)  # round complete
+            except OSError:
+                pass
         return out
 
     def aggregate(self, inputs, key=None) -> np.ndarray:

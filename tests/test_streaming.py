@@ -205,3 +205,87 @@ def test_streaming_aggregator_additive_schemes():
         np.testing.assert_array_equal(
             out, expected, err_msg=type(masking).__name__
         )
+
+
+def test_streaming_checkpoint_resume_bit_identical(tmp_path):
+    """A crash mid-round resumes from the snapshot and produces the exact
+    bytes of an uninterrupted run (tile keys are pure functions of the
+    round key and tile indices), skipping already-folded chunks."""
+    import os
+
+    from sda_tpu.mesh import synthetic_block_provider32
+
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    s = PackedShamirSharing(3, 8, t, p, w2, w3)
+    key = jax.random.PRNGKey(7)
+    prov = synthetic_block_provider32(p, seed=4, max_value=1 << 20)
+    ck = str(tmp_path / "round.ckpt.npz")
+
+    def agg():
+        return StreamingAggregator(
+            s, FullMasking(p), participants_chunk=4, dim_chunk=24
+        )
+
+    ref = agg().aggregate_blocks(prov, 23, 100, key)
+
+    calls = {"n": 0}
+
+    def flaky(p0, p1, d0, d1):
+        calls["n"] += 1
+        if calls["n"] == 13:
+            raise RuntimeError("simulated crash")
+        return prov(p0, p1, d0, d1)
+
+    with pytest.raises(RuntimeError):
+        agg().aggregate_blocks(flaky, 23, 100, key, checkpoint_path=ck,
+                               checkpoint_every_chunks=2)
+    assert os.path.exists(ck)
+
+    resumed_calls = {"n": 0}
+
+    def counting(p0, p1, d0, d1):
+        resumed_calls["n"] += 1
+        return prov(p0, p1, d0, d1)
+
+    out = agg().aggregate_blocks(counting, 23, 100, key, checkpoint_path=ck,
+                                 checkpoint_every_chunks=2)
+    np.testing.assert_array_equal(out, ref)
+    assert not os.path.exists(ck)  # removed on completion
+    total_chunks = (-(-23 // 4)) * (-(-100 // 24))
+    assert resumed_calls["n"] < total_chunks  # resume skipped folded chunks
+
+
+def test_streaming_checkpoint_rejects_foreign_snapshot(tmp_path):
+    """A snapshot from a different round (different key) is ignored: the
+    fingerprint mismatch forces a clean fresh run, never a silent mix."""
+    from sda_tpu.mesh import synthetic_block_provider32
+
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    s = PackedShamirSharing(3, 8, t, p, w2, w3)
+    prov = synthetic_block_provider32(p, seed=4, max_value=1 << 20)
+    ck = str(tmp_path / "round.ckpt.npz")
+
+    def agg():
+        return StreamingAggregator(
+            s, FullMasking(p), participants_chunk=4, dim_chunk=24
+        )
+
+    import os
+
+    calls = {"n": 0}
+
+    def boom(p0, p1, d0, d1):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("crash")
+        return prov(p0, p1, d0, d1)
+
+    with pytest.raises(RuntimeError):
+        agg().aggregate_blocks(boom, 23, 100, jax.random.PRNGKey(7),
+                               checkpoint_path=ck, checkpoint_every_chunks=1)
+    assert os.path.exists(ck)  # a key-7 snapshot exists
+    # different key: snapshot must not be trusted
+    out = agg().aggregate_blocks(prov, 23, 100, jax.random.PRNGKey(8),
+                                 checkpoint_path=ck)
+    exp = agg().aggregate_blocks(prov, 23, 100, jax.random.PRNGKey(8))
+    np.testing.assert_array_equal(out, exp)
