@@ -19,6 +19,7 @@ from ..protocol import (
     Agent,
     Aggregation,
     AggregationId,
+    BasicShamirSharing,
     ChaChaMasking,
     EncryptionKeyId,
     FullMasking,
@@ -64,8 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
     create.add_argument("--modulus", type=int, required=True)
     create.add_argument("--mask", choices=["none", "full", "chacha"], default="none")
     create.add_argument("--seed-bits", type=int, default=128)
-    create.add_argument("--sharing", choices=["add", "shamir"], default="add")
+    create.add_argument("--sharing", choices=["add", "shamir", "basic-shamir"],
+                        default="add")
     create.add_argument("--shares", type=int, default=3, help="committee size")
+    create.add_argument("--privacy-threshold", type=int, default=None,
+                        help="basic-shamir only: colluding-clerk bound t "
+                             "(reconstruction needs t+1 shares; default "
+                             "(shares-1)//2, honest majority)")
     create.add_argument("--encryption", choices=["sodium", "paillier"],
                         default="sodium",
                         help="share-transport encryption for both slots "
@@ -171,6 +177,37 @@ def main(argv=None) -> int:
                 masking = ChaChaMasking(args.modulus, args.dimension, args.seed_bits)
             if args.sharing == "add":
                 sharing = AdditiveSharing(share_count=args.shares, modulus=args.modulus)
+            elif args.sharing == "basic-shamir":
+                from ..fields import numtheory
+
+                # classic Shamir (the reference's declared-but-disabled
+                # BasicShamir, crypto.rs:89-95): any prime works — pick a
+                # Solinas one with participant-sum headroom, same policy
+                # and capacity reporting as the packed path below
+                min_bits = min(args.modulus.bit_length() + 21, 28)
+                bp = numtheory.find_prime_with_orders(1, 1, min_bits)
+                t = (args.privacy_threshold if args.privacy_threshold
+                     is not None else max(1, (args.shares - 1) // 2))
+                try:
+                    sharing = BasicShamirSharing(args.shares, t, bp)
+                except ValueError as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 1
+                if args.modulus != bp:  # native mod-p runs are exact as-is
+                    capacity = (bp - 1) // max(1, args.modulus - 1)
+                    if capacity < 2:
+                        print(f"error: modulus {args.modulus} does not fit "
+                              f"the sharing prime {bp}; use a smaller "
+                              f"modulus", file=sys.stderr)
+                        return 1
+                    print(f"note: basic Shamir over prime {bp}, t={t} "
+                          f"(reveal needs {t + 1} of {args.shares} clerks); "
+                          f"sums stay exact for up to {capacity} "
+                          f"participants at modulus {args.modulus}",
+                          file=sys.stderr)
+                    if capacity < 1000:
+                        print("warning: <1000-participant headroom — use a "
+                              "smaller modulus", file=sys.stderr)
             else:
                 from ..fields import numtheory
 
@@ -209,7 +246,8 @@ def main(argv=None) -> int:
                 # shares/partial-sums live mod the SHARING modulus (the NTT
                 # prime for shamir), and ChaCha "masks" are 32-bit seed words
                 share_bits = (
-                    sharing.prime_modulus if args.sharing == "shamir"
+                    sharing.prime_modulus
+                    if args.sharing in ("shamir", "basic-shamir")
                     else sharing.modulus
                 ).bit_length()
                 value_bits = max(share_bits, 32 if args.mask == "chacha" else 0)

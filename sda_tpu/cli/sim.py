@@ -25,7 +25,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--participants", type=int, default=64)
     parser.add_argument("--dim", type=int, default=9999)
     parser.add_argument("--clerks", type=int, default=8,
-                        help="committee size (3^a - 1: 2, 8, 26, ...)")
+                        help="committee size (packed sharing needs "
+                             "3^a - 1: 2, 8, 26, ...; basic takes any)")
+    parser.add_argument("--sharing", choices=["packed", "basic"],
+                        default="packed",
+                        help="packed (NTT Shamir, k secrets/poly) or basic "
+                             "(classic t+1-of-n Shamir, any committee size)")
     parser.add_argument("--secrets-per-batch", type=int, default=3)
     parser.add_argument("--modulus-bits", type=int, default=28)
     parser.add_argument("--mask", choices=["none", "full", "chacha"],
@@ -164,9 +169,17 @@ def main(argv=None) -> int:
     from ..mesh import SimulatedPod, StreamingAggregator
     from ..protocol import ChaChaMasking, FullMasking, NoMasking, PackedShamirSharing
 
-    k = args.secrets_per_batch
-    t, p, w2, w3 = numtheory.generate_packed_params(k, args.clerks, args.modulus_bits)
-    scheme = PackedShamirSharing(k, args.clerks, t, p, w2, w3)
+    if args.sharing == "basic":
+        from ..protocol import BasicShamirSharing
+
+        p = numtheory.find_prime_with_orders(1, 1, args.modulus_bits)
+        t = max(1, (args.clerks - 1) // 2)  # honest majority
+        scheme = BasicShamirSharing(args.clerks, t, p)
+    else:
+        k = args.secrets_per_batch
+        t, p, w2, w3 = numtheory.generate_packed_params(
+            k, args.clerks, args.modulus_bits)
+        scheme = PackedShamirSharing(k, args.clerks, t, p, w2, w3)
     survivors = None
     if args.drop_clerks:
         try:

@@ -19,6 +19,7 @@ from .. import fields
 from ..fields import numtheory, oracle
 from ..protocol import (
     AdditiveSharing,
+    BasicShamirSharing,
     LinearSecretSharingScheme,
     PackedShamirSharing,
 )
@@ -110,11 +111,8 @@ class PackedShamirShareGenerator(ShareGenerator):
     def _M(self):
         # built lazily so host-path-only use never touches the device
         if self._M_device is None:
-            s = self.scheme
-            self._M_device = jnp.asarray(numtheory.packed_share_matrix(
-                s.secret_count, s.share_count, s.privacy_threshold,
-                s.prime_modulus, s.omega_secrets, s.omega_shares,
-            ))
+            self._M_device = jnp.asarray(
+                numtheory.share_matrix_for(self.scheme))
         return self._M_device
 
     def generate(self, secrets):
@@ -155,10 +153,7 @@ class PackedShamirReconstructor(SecretReconstructor):
         stacked_np = np.stack([np.asarray(v, dtype=np.int64) for (_, v) in indexed_shares])
         if _small(stacked_np.size):
             return oracle.packed_reconstruct(indices, stacked_np, s, self.dimension)
-        L = jnp.asarray(numtheory.packed_reconstruct_matrix(
-            s.secret_count, s.share_count, s.privacy_threshold,
-            s.prime_modulus, s.omega_secrets, s.omega_shares, indices,
-        ))
+        L = jnp.asarray(numtheory.reconstruct_matrix_for(s, indices))
         return np.asarray(fields.packed_reconstruct(
             jnp.asarray(stacked_np), L, prime=s.prime_modulus, dimension=self.dimension
         ))
@@ -167,7 +162,10 @@ class PackedShamirReconstructor(SecretReconstructor):
 def new_share_generator(scheme: LinearSecretSharingScheme) -> ShareGenerator:
     if isinstance(scheme, AdditiveSharing):
         return AdditiveShareGenerator(scheme)
-    if isinstance(scheme, PackedShamirSharing):
+    if isinstance(scheme, (PackedShamirSharing, BasicShamirSharing)):
+        # BasicShamir rides the packed machinery as its k=1 degenerate:
+        # same [0; secrets; randomness] column layout, scheme-dispatched
+        # matrices (numtheory.share_matrix_for)
         return PackedShamirShareGenerator(scheme)
     raise ValueError(f"unknown sharing scheme {scheme!r}")
 
@@ -175,7 +173,7 @@ def new_share_generator(scheme: LinearSecretSharingScheme) -> ShareGenerator:
 def new_share_combiner(scheme: LinearSecretSharingScheme) -> ShareCombiner:
     if isinstance(scheme, AdditiveSharing):
         return ShareCombiner(scheme.modulus)
-    if isinstance(scheme, PackedShamirSharing):
+    if isinstance(scheme, (PackedShamirSharing, BasicShamirSharing)):
         return ShareCombiner(scheme.prime_modulus)
     raise ValueError(f"unknown sharing scheme {scheme!r}")
 
@@ -185,6 +183,6 @@ def new_secret_reconstructor(
 ) -> SecretReconstructor:
     if isinstance(scheme, AdditiveSharing):
         return AdditiveReconstructor(scheme)
-    if isinstance(scheme, PackedShamirSharing):
+    if isinstance(scheme, (PackedShamirSharing, BasicShamirSharing)):
         return PackedShamirReconstructor(scheme, dimension)
     raise ValueError(f"unknown sharing scheme {scheme!r}")

@@ -232,6 +232,84 @@ def packed_share_matrix(
     return out
 
 
+@functools.lru_cache(maxsize=64)
+def basic_share_matrix(
+    share_count: int, privacy_threshold: int, prime_modulus: int
+) -> np.ndarray:
+    """The [share_count, 2+t] matrix M with shares = M @ values (mod p) for
+    classic Shamir (protocol BasicShamirSharing; reference declaration
+    crypto.rs:89-95).
+
+    values = [0 (fixed, keeps the packed-layout convention); secret;
+    t random coefficients]. Share i (0-based row) is f(i+1) for
+    f(x) = secret + sum_j r_j x^j — so M[i] = [0, 1, x_i, ..., x_i^t] with
+    x_i = i + 1. No root-of-unity structure needed: any prime >
+    share_count works (points 1..n stay distinct and nonzero).
+    """
+    n, t, p = share_count, privacy_threshold, prime_modulus
+    if not 1 <= t < n:
+        raise ValueError(f"privacy threshold {t} must be in [1, {n})")
+    if p <= n:
+        raise ValueError(f"prime {p} must exceed share_count {n}")
+    M = [[0, 1] + [pow(i + 1, j, p) for j in range(1, t + 1)]
+         for i in range(n)]
+    out = np.array(M, dtype=np.int64)
+    out.setflags(write=False)  # cached and shared; callers must not mutate
+    return out
+
+
+@functools.lru_cache(maxsize=256)
+def basic_reconstruct_matrix(
+    share_count: int, privacy_threshold: int, prime_modulus: int,
+    indices: Tuple[int, ...],
+) -> np.ndarray:
+    """The [1, len(indices)+1] matrix L with [secret] = L @ [0; shares]:
+    Lagrange interpolation at zero through points {i+1 for i in indices}.
+    Any ``privacy_threshold + 1`` of the shares suffice; interpolating
+    through a superset of surviving points yields the same degree-<=t
+    polynomial, so larger sets stay exact."""
+    n, t, p = share_count, privacy_threshold, prime_modulus
+    if len(set(indices)) != len(indices):
+        raise ValueError("duplicate share indices")
+    if any(i < 0 or i >= n for i in indices):
+        raise ValueError("share index out of range")
+    if len(indices) < t + 1:
+        raise ValueError(
+            f"need at least {t + 1} shares to reconstruct, got {len(indices)}"
+        )
+    points = [i + 1 for i in indices]
+    row = _lagrange_basis_row(points, 0, p)
+    out = np.array([[0] + row], dtype=np.int64)
+    out.setflags(write=False)
+    return out
+
+
+def share_matrix_for(scheme) -> np.ndarray:
+    """Scheme-dispatched share matrix (PackedShamir | BasicShamir)."""
+    if hasattr(scheme, "omega_secrets"):
+        return packed_share_matrix(
+            scheme.secret_count, scheme.share_count, scheme.privacy_threshold,
+            scheme.prime_modulus, scheme.omega_secrets, scheme.omega_shares,
+        )
+    return basic_share_matrix(
+        scheme.share_count, scheme.privacy_threshold, scheme.prime_modulus
+    )
+
+
+def reconstruct_matrix_for(scheme, indices: Tuple[int, ...]) -> np.ndarray:
+    """Scheme-dispatched reconstruction matrix for surviving ``indices``."""
+    if hasattr(scheme, "omega_secrets"):
+        return packed_reconstruct_matrix(
+            scheme.secret_count, scheme.share_count, scheme.privacy_threshold,
+            scheme.prime_modulus, scheme.omega_secrets, scheme.omega_shares,
+            tuple(indices),
+        )
+    return basic_reconstruct_matrix(
+        scheme.share_count, scheme.privacy_threshold, scheme.prime_modulus,
+        tuple(indices),
+    )
+
+
 def _lagrange_basis_row(points: Sequence[int], x: int, p: int) -> List[int]:
     """Lagrange basis weights l_j(x) for interpolation points ``points``."""
     n = len(points)

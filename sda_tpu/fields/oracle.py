@@ -49,14 +49,7 @@ def combine(shares, modulus: int) -> np.ndarray:
 
 def packed_share_from_randomness(secrets, randomness, scheme) -> np.ndarray:
     """[d] secrets + [t, B] randomness -> [n, B] clerk share rows."""
-    M = numtheory.packed_share_matrix(
-        scheme.secret_count,
-        scheme.share_count,
-        scheme.privacy_threshold,
-        scheme.prime_modulus,
-        scheme.omega_secrets,
-        scheme.omega_shares,
-    )
+    M = numtheory.share_matrix_for(scheme)
     sk = batch_columns(np.asarray(secrets, dtype=np.int64), scheme.secret_count)
     zeros = np.zeros(sk.shape[:-2] + (1,) + sk.shape[-1:], dtype=np.int64)
     values = np.concatenate([zeros, sk, np.asarray(randomness, dtype=np.int64)], axis=-2)
@@ -65,15 +58,7 @@ def packed_share_from_randomness(secrets, randomness, scheme) -> np.ndarray:
 
 def packed_reconstruct(indices, shares, scheme, dimension: int) -> np.ndarray:
     """Surviving (indices, [r, B] share rows) -> [d] secrets."""
-    L = numtheory.packed_reconstruct_matrix(
-        scheme.secret_count,
-        scheme.share_count,
-        scheme.privacy_threshold,
-        scheme.prime_modulus,
-        scheme.omega_secrets,
-        scheme.omega_shares,
-        tuple(indices),
-    )
+    L = numtheory.reconstruct_matrix_for(scheme, tuple(indices))
     shares = np.asarray(shares, dtype=np.int64)
     values = np.concatenate([np.zeros((1,) + shares.shape[1:], dtype=np.int64), shares], axis=0)
     return unbatch_columns(np_modmatmul(L, values, scheme.prime_modulus), dimension)

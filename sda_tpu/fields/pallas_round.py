@@ -317,15 +317,10 @@ def single_chip_round_pallas(
     if sp is None:
         raise ValueError(f"prime {s.prime_modulus} is not Solinas-form")
     masked = isinstance(masking, FullMasking)
-    m_host = numtheory.packed_share_matrix(
-        s.secret_count, s.share_count, s.privacy_threshold,
-        s.prime_modulus, s.omega_secrets, s.omega_shares,
-    )
-    l_host = numtheory.packed_reconstruct_matrix(
-        s.secret_count, s.share_count, s.privacy_threshold,
-        s.prime_modulus, s.omega_secrets, s.omega_shares,
-        tuple(range(s.share_count)),
-    )
+    # scheme-dispatched matrices: PackedShamir (NTT) or BasicShamir
+    # (Vandermonde/Lagrange, k=1) — the kernel is layout-agnostic
+    m_host = numtheory.share_matrix_for(s)
+    l_host = numtheory.reconstruct_matrix_for(s, tuple(range(s.share_count)))
     k = s.secret_count
     t = s.privacy_threshold
     draws = (k + t) if masked else t

@@ -56,6 +56,7 @@ from ..fields.ops import FieldOps
 from ..utils import timed_phase
 from ..protocol import (
     AdditiveSharing,
+    BasicShamirSharing,
     ChaChaMasking,
     FullMasking,
     LinearMaskingScheme,
@@ -64,6 +65,10 @@ from ..protocol import (
     PackedShamirSharing,
 )
 
+#: schemes whose share/reconstruct are host-built matrices applied as
+#: device matmuls (numtheory.share_matrix_for / reconstruct_matrix_for)
+SHAMIR_SCHEMES = (PackedShamirSharing, BasicShamirSharing)
+
 
 # re-export: lives in fields.fastfield (pure field arithmetic); kept under
 # the old name for existing importers
@@ -71,7 +76,7 @@ _to_residues32 = fastfield.to_residues32
 
 
 def _scheme_modulus(scheme: LinearSecretSharingScheme) -> int:
-    if isinstance(scheme, PackedShamirSharing):
+    if isinstance(scheme, SHAMIR_SCHEMES):
         return scheme.prime_modulus
     if isinstance(scheme, AdditiveSharing):
         return scheme.modulus
@@ -231,7 +236,7 @@ def _share_sum_stage(scheme, f: FieldOps, M_host, masked, skey):
     tests/test_mesh.py and test_fast_rounds.py pin this equivalence.
     """
     S, d = masked.shape
-    if isinstance(scheme, PackedShamirSharing):
+    if isinstance(scheme, SHAMIR_SCHEMES):
         k, t = scheme.secret_count, scheme.privacy_threshold
         B = -(-d // k)
         rand = f.uniform(skey, (S, t, B))
@@ -257,7 +262,7 @@ def _pallas_supported(scheme, masking, f: FieldOps) -> bool:
     None/Full masking (ChaCha masks must come from the versioned wire PRG,
     which the kernel does not generate)."""
     return (
-        isinstance(scheme, PackedShamirSharing)
+        isinstance(scheme, SHAMIR_SCHEMES)
         and f.sp is not None
         and isinstance(masking, (NoMasking, FullMasking))
     )
@@ -372,7 +377,7 @@ def _scan_combine(f: FieldOps, scheme, masking, M_host, x, key, round_key,
 
 def _reconstruct_stage(scheme, f: FieldOps, L_host, gathered, d_loc: int):
     """[n, B] clerk rows -> [d_loc] masked totals."""
-    if isinstance(scheme, PackedShamirSharing):
+    if isinstance(scheme, SHAMIR_SCHEMES):
         if f.sp is not None:
             return sharing.packed_reconstruct32(
                 gathered, L_host, f.sp, dimension=d_loc
@@ -394,17 +399,12 @@ def _dim_grain(scheme, masking) -> int:
 
 
 def _build_matrices(scheme, survivors: Optional[Tuple[int, ...]] = None):
-    if not isinstance(scheme, PackedShamirSharing):
+    if not isinstance(scheme, SHAMIR_SCHEMES):
         return None, None
-    s = scheme
-    M = numtheory.packed_share_matrix(
-        s.secret_count, s.share_count, s.privacy_threshold,
-        s.prime_modulus, s.omega_secrets, s.omega_shares,
-    )
-    L = numtheory.packed_reconstruct_matrix(
-        s.secret_count, s.share_count, s.privacy_threshold,
-        s.prime_modulus, s.omega_secrets, s.omega_shares,
-        tuple(range(s.share_count)) if survivors is None else survivors,
+    M = numtheory.share_matrix_for(scheme)
+    L = numtheory.reconstruct_matrix_for(
+        scheme,
+        tuple(range(scheme.share_count)) if survivors is None else survivors,
     )
     return M, L
 
@@ -427,11 +427,11 @@ def _normalize_survivors(scheme, surviving_clerks) -> Optional[Tuple[int, ...]]:
     n = scheme.output_size
     if any(i < 0 or i >= n for i in survivors) or len(set(survivors)) != len(survivors):
         raise ValueError(f"surviving clerks {survivors} must be distinct in [0, {n})")
-    if not isinstance(scheme, PackedShamirSharing):
+    if not isinstance(scheme, SHAMIR_SCHEMES):
         if len(survivors) < n:
             raise ValueError(
                 "additive sharing needs every clerk row; clerk dropout "
-                "requires packed Shamir (crypto.rs:146-153)"
+                "requires a Shamir scheme (crypto.rs:146-153)"
             )
         return None  # all rows = the normal finale
     r = scheme.reconstruction_threshold

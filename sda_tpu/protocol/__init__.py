@@ -21,6 +21,7 @@ from .helpers import (
 from .crypto import (
     AdditiveEncryptionScheme,
     AdditiveSharing,
+    BasicShamirSharing,
     ChaChaMasking,
     Encryption,
     EncryptionKey,

@@ -150,6 +150,12 @@ class LinearSecretSharingScheme:
             [(variant, p)] = obj.items()
             if variant == "Additive":
                 return AdditiveSharing(share_count=p["share_count"], modulus=p["modulus"])
+            if variant == "BasicShamir":
+                return BasicShamirSharing(
+                    share_count=p["share_count"],
+                    privacy_threshold=p["privacy_threshold"],
+                    prime_modulus=p["prime_modulus"],
+                )
             if variant == "PackedShamir":
                 return PackedShamirSharing(
                     secret_count=p["secret_count"],
@@ -194,6 +200,67 @@ class AdditiveSharing(LinearSecretSharingScheme):
 
     def to_obj(self):
         return {"Additive": {"share_count": self.share_count, "modulus": self.modulus}}
+
+
+class BasicShamirSharing(LinearSecretSharingScheme):
+    """Classic (non-packed) Shamir over Z_p: one secret per polynomial,
+    any ``privacy_threshold + 1`` of ``share_count`` shares reconstruct.
+
+    The reference DECLARES this variant but ships it commented out
+    (protocol/src/crypto.rs:89-95: share_count, privacy_threshold,
+    prime_modulus), with its derived properties spelled out in the
+    commented match arms of crypto.rs:117-155 (input_size 1,
+    output_size share_count, reconstruction_threshold t + 1). Implemented
+    for real here: shares are Vandermonde evaluations at points 1..n and
+    reconstruction is Lagrange interpolation at zero — host-built
+    matrices applied with the same device matmuls as the packed scheme,
+    so every execution mode (federated, pod, streamed, Pallas, dropout
+    quorums) works unchanged. Unlike PackedShamir the prime needs no
+    root-of-unity structure: ANY prime > share_count qualifies.
+    """
+
+    def __init__(self, share_count: int, privacy_threshold: int,
+                 prime_modulus: int):
+        self.share_count = int(share_count)
+        self._privacy_threshold = int(privacy_threshold)
+        self.prime_modulus = int(prime_modulus)
+        if not 1 <= self._privacy_threshold < self.share_count:
+            raise ValueError(
+                f"privacy threshold {privacy_threshold} must be in "
+                f"[1, share_count {share_count})"
+            )
+        if self.prime_modulus <= self.share_count:
+            raise ValueError(
+                f"prime modulus {prime_modulus} must exceed share_count "
+                f"{share_count} (evaluation points 1..n must be distinct "
+                f"and nonzero mod p)"
+            )
+
+    #: one secret per polynomial — the k=1 degenerate of the packed layout,
+    #: so downstream batching/matrix code is shared
+    secret_count = 1
+    input_size = 1
+
+    @property
+    def output_size(self) -> int:
+        return self.share_count
+
+    @property
+    def privacy_threshold(self) -> int:
+        return self._privacy_threshold
+
+    @property
+    def reconstruction_threshold(self) -> int:
+        return self._privacy_threshold + 1
+
+    def to_obj(self):
+        return {
+            "BasicShamir": {
+                "share_count": self.share_count,
+                "privacy_threshold": self._privacy_threshold,
+                "prime_modulus": self.prime_modulus,
+            }
+        }
 
 
 class PackedShamirSharing(LinearSecretSharingScheme):

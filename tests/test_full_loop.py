@@ -174,6 +174,23 @@ def test_with_packedshamir(service):
     )
 
 
+def test_with_basicshamir(service):
+    """Beyond the reference's enabled surface: the declared-but-disabled
+    BasicShamir variant (crypto.rs:89-95) through the complete protocol
+    stack — 3-of-5 quorum, ChaCha masking."""
+    from sda_tpu.protocol import BasicShamirSharing
+
+    check_full_aggregation(
+        agg_default().replace(
+            committee_sharing_scheme=BasicShamirSharing(
+                share_count=5, privacy_threshold=2, prime_modulus=433,
+            ),
+            masking_scheme=ChaChaMasking(433, 4, 128),
+        ),
+        service,
+    )
+
+
 def test_packedshamir_with_clerk_dropout(service):
     """Beyond the reference suite: reconstruction succeeds when one clerk
     never does its job (fault tolerance, crypto.rs:146-153), exercising the

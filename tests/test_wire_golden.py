@@ -36,6 +36,7 @@ from sda_tpu.protocol import (
     Aggregation,
     AggregationId,
     AgentId,
+    BasicShamirSharing,
     ChaChaMasking,
     ClerkingJobId,
     Encryption,
@@ -319,6 +320,13 @@ def test_canonical_scheme_variants():
         '{"PackedShamir":{"secret_count":3,"share_count":8,'
         '"privacy_threshold":4,"prime_modulus":433,'
         '"omega_secrets":354,"omega_shares":150}}'
+    )
+    # BasicShamir: field order share_count, privacy_threshold, prime_modulus
+    # per the reference's declared-but-disabled variant (crypto.rs:89-95 —
+    # our framework enables it)
+    assert canon(BasicShamirSharing(5, 2, 433)) == (
+        '{"BasicShamir":{"share_count":5,"privacy_threshold":2,'
+        '"prime_modulus":433}}'
     )
     assert canon(FullMasking(433)) == '{"Full":{"modulus":433}}'
     assert canon(ChaChaMasking(433, 10, 128)) == (
