@@ -244,17 +244,14 @@ def _e2e_streamed_run(agg, prov_host, prov_dev, participants_run, dim,
     from sda_tpu.utils import phase_report, reset_phase_report
 
     prov = prov_dev if device_generated else prov_host
-    # ground truth, not a bare exists(): a foreign/damaged snapshot is
-    # rejected by fingerprint and the run is a genuine full round
-    resumed = False
-    if checkpoint_path and os.path.exists(checkpoint_path):
-        fp = agg._checkpoint_fingerprint(participants_run, dim, key)
-        resumed = agg._checkpoint_load(checkpoint_path, fp) is not None
     reset_phase_report()
     t0 = _time.perf_counter()
     out = agg.aggregate_blocks(prov, participants_run, dim, key,
                                checkpoint_path=checkpoint_path)
     wall = _time.perf_counter() - t0
+    # ground truth from the driver itself: a foreign/damaged snapshot is
+    # rejected by fingerprint and the run is a genuine full round
+    resumed = bool(getattr(agg, "last_resumed", False))
     phases = {k: v for k, v in phase_report().items()
               if k.startswith("stream.")}
 

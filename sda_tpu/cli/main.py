@@ -114,6 +114,27 @@ def _primary_key(client: SdaClient, store: Filebased) -> EncryptionKeyId:
     return EncryptionKeyId(record["id"])
 
 
+def _check_prime_capacity(prime: int, modulus: int, note: str) -> bool:
+    """Shared participant-headroom policy for the Shamir sharing paths:
+    correctness needs participants * (modulus-1) < prime. Returns False
+    (after printing an error) when even 2 participants can wrap."""
+    if modulus == prime:  # native mod-p runs are exact as-is
+        return True
+    capacity = (prime - 1) // max(1, modulus - 1)
+    if capacity < 2:
+        print(f"error: modulus {modulus} does not fit the sharing prime "
+              f"{prime} (even a 2-participant sum can wrap mod p and "
+              f"reveal a wrong aggregate); use a smaller modulus",
+              file=sys.stderr)
+        return False
+    print(f"note: {note}; sums stay exact for up to {capacity} "
+          f"participants at modulus {modulus}", file=sys.stderr)
+    if capacity < 1000:
+        print("warning: <1000-participant headroom — use a smaller "
+              "modulus or a larger prime", file=sys.stderr)
+    return True
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from ..utils import configure_logging
@@ -193,21 +214,11 @@ def main(argv=None) -> int:
                 except ValueError as e:
                     print(f"error: {e}", file=sys.stderr)
                     return 1
-                if args.modulus != bp:  # native mod-p runs are exact as-is
-                    capacity = (bp - 1) // max(1, args.modulus - 1)
-                    if capacity < 2:
-                        print(f"error: modulus {args.modulus} does not fit "
-                              f"the sharing prime {bp}; use a smaller "
-                              f"modulus", file=sys.stderr)
-                        return 1
-                    print(f"note: basic Shamir over prime {bp}, t={t} "
-                          f"(reveal needs {t + 1} of {args.shares} clerks); "
-                          f"sums stay exact for up to {capacity} "
-                          f"participants at modulus {args.modulus}",
-                          file=sys.stderr)
-                    if capacity < 1000:
-                        print("warning: <1000-participant headroom — use a "
-                              "smaller modulus", file=sys.stderr)
+                if not _check_prime_capacity(
+                        bp, args.modulus,
+                        f"basic Shamir over prime {bp}, t={t} (reveal "
+                        f"needs {t + 1} of {args.shares} clerks)"):
+                    return 1
             else:
                 from ..fields import numtheory
 
@@ -224,22 +235,9 @@ def main(argv=None) -> int:
                 t, p, w2, w3 = numtheory.generate_packed_params(
                     k, args.shares, min_modulus_bits=min_bits
                 )
-                if args.modulus != p:
-                    capacity = (p - 1) // max(1, args.modulus - 1)
-                    if capacity < 2:
-                        print(f"error: modulus {args.modulus} does not fit the "
-                              f"NTT prime {p} (even a 2-participant sum can "
-                              f"wrap mod p and reveal a wrong aggregate); use "
-                              f"a smaller modulus", file=sys.stderr)
-                        return 1
-                    print(f"note: sharing over NTT prime {p}; sums stay exact "
-                          f"for up to {capacity} participants at modulus "
-                          f"{args.modulus}", file=sys.stderr)
-                    if capacity < 1000:
-                        print("warning: <1000-participant headroom — use a "
-                              "smaller modulus or a larger prime "
-                              "(--secrets-per-batch/--shares affect the "
-                              "generator)", file=sys.stderr)
+                if not _check_prime_capacity(
+                        p, args.modulus, f"sharing over NTT prime {p}"):
+                    return 1
                 sharing = PackedShamirSharing(k, args.shares, t, p, w2, w3)
             if args.encryption == "paillier":
                 # windows must fit the widest values each slot carries:

@@ -31,7 +31,8 @@ def build_parser() -> argparse.ArgumentParser:
                         default="packed",
                         help="packed (NTT Shamir, k secrets/poly) or basic "
                              "(classic t+1-of-n Shamir, any committee size)")
-    parser.add_argument("--secrets-per-batch", type=int, default=3)
+    parser.add_argument("--secrets-per-batch", type=int, default=None,
+                        help="packed sharing only (default 3)")
     parser.add_argument("--modulus-bits", type=int, default=28)
     parser.add_argument("--mask", choices=["none", "full", "chacha"],
                         default="full")
@@ -172,11 +173,15 @@ def main(argv=None) -> int:
     if args.sharing == "basic":
         from ..protocol import BasicShamirSharing
 
+        if args.secrets_per_batch is not None:
+            print("note: --secrets-per-batch applies to packed sharing "
+                  "only; basic Shamir packs one secret per polynomial",
+                  file=sys.stderr)
         p = numtheory.find_prime_with_orders(1, 1, args.modulus_bits)
         t = max(1, (args.clerks - 1) // 2)  # honest majority
         scheme = BasicShamirSharing(args.clerks, t, p)
     else:
-        k = args.secrets_per_batch
+        k = args.secrets_per_batch if args.secrets_per_batch is not None else 3
         t, p, w2, w3 = numtheory.generate_packed_params(
             k, args.clerks, args.modulus_bits)
         scheme = PackedShamirSharing(k, args.clerks, t, p, w2, w3)

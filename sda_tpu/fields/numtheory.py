@@ -108,7 +108,10 @@ def find_prime_with_orders(order2: int, order3: int, min_bits: int = 0) -> int:
     for b in range(max(min_bits + 1, 20), 30):
         for delta in range(1, 1 << 13):
             p = (1 << b) - delta
-            if p < (1 << min_bits) or p % step != 1:
+            # step == 1 (no order constraints, e.g. BasicShamir primes) is
+            # trivially satisfied; p % 1 == 0 would otherwise skip every
+            # candidate and silently lose the Solinas fast path
+            if p < (1 << min_bits) or (step > 1 and p % step != 1):
                 continue
             if fastfield.supported(p) and is_prime(p):
                 return p

@@ -376,6 +376,9 @@ class StreamingAggregator:
             if resume is not None:
                 nd = int(resume["done_dims"])
                 out[:nd] = resume["out"]
+        #: whether the LAST aggregate_blocks call resumed from a snapshot
+        #: (ground truth for callers recording resumed runs, e.g. benches)
+        self.last_resumed = resume is not None
         resume_di = int(resume["di"]) if resume is not None else -1
         resume_pi = int(resume["pi"]) if resume is not None else 0
         for di, d0 in enumerate(range(0, dimension, self.dim_chunk)):

@@ -64,7 +64,7 @@ def test_pod_deterministic_given_key():
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
 @pytest.mark.parametrize("config", [
     "add-none", "add-full", "add-chacha", "shamir-none", "shamir-full",
-    "shamir-chacha",
+    "shamir-chacha", "basic-none", "basic-full", "basic-chacha",
 ])
 def test_pod_scheme_parity(mesh_shape, config):
     """Every masking x sharing point of the scheme lattice runs in pod mode
@@ -80,7 +80,7 @@ def test_pod_scheme_parity(mesh_shape, config):
 
 @pytest.mark.parametrize("config", [
     "add-none", "add-full", "add-chacha", "shamir-none", "shamir-full",
-    "shamir-chacha",
+    "shamir-chacha", "basic-none", "basic-full", "basic-chacha",
 ])
 def test_single_chip_scheme_parity(config):
     """The collective-free round covers the same scheme lattice (ChaCha

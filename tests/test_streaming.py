@@ -100,7 +100,7 @@ from util import scheme_lattice_config as _streamed_config
 
 @needs8
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
-@pytest.mark.parametrize("config", ["shamir-full", "add-chacha"])
+@pytest.mark.parametrize("config", ["shamir-full", "add-chacha", "basic-chacha"])
 def test_streamed_pod_exact(mesh_shape, config):
     """Tiled multi-device rounds (collective-free steps, one transpose per
     dim tile) aggregate exactly, including ragged edge tiles."""

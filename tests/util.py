@@ -99,16 +99,19 @@ def scheme_lattice_config(name, dim, *, additive_share_count=8):
     pluggability: masking/mod.rs:33-94 x sharing/mod.rs:35-96), mod 433."""
     from sda_tpu.protocol import (
         AdditiveSharing,
+        BasicShamirSharing,
         ChaChaMasking,
         FullMasking,
         PackedShamirSharing,
     )
 
-    sharing = (
-        AdditiveSharing(share_count=additive_share_count, modulus=433)
-        if name.startswith("add")
-        else PackedShamirSharing(3, 8, 4, 433, 354, 150)
-    )
+    if name.startswith("add"):
+        sharing = AdditiveSharing(share_count=additive_share_count, modulus=433)
+    elif name.startswith("basic"):
+        sharing = BasicShamirSharing(share_count=8, privacy_threshold=4,
+                                     prime_modulus=433)
+    else:
+        sharing = PackedShamirSharing(3, 8, 4, 433, 354, 150)
     masking = {
         "none": None,
         "full": FullMasking(433),
