@@ -141,15 +141,8 @@ def _phase_breakdown(scheme, inputs, key):
     if sp is None:
         return {}
     P, d = inputs.shape
-    M_host = numtheory.packed_share_matrix(
-        s.secret_count, s.share_count, s.privacy_threshold,
-        s.prime_modulus, s.omega_secrets, s.omega_shares,
-    )
-    L_host = numtheory.packed_reconstruct_matrix(
-        s.secret_count, s.share_count, s.privacy_threshold,
-        s.prime_modulus, s.omega_secrets, s.omega_shares,
-        tuple(range(s.share_count)),
-    )
+    M_host = numtheory.share_matrix_for(s)
+    L_host = numtheory.reconstruct_matrix_for(s, tuple(range(s.share_count)))
     mask_fn = jax.jit(lambda k: fastfield.uniform32(k, (P, d), sp))
     share_fn = jax.jit(lambda k, x: sharing.packed_share32(
         k, x, M_host, sp,
@@ -179,14 +172,23 @@ def _phase_breakdown(scheme, inputs, key):
     }
 
 
-def _round_bench(name, participants, dim):
+def _basic_scheme(bits=28):
+    from sda_tpu.fields import numtheory
+    from sda_tpu.protocol import BasicShamirSharing
+
+    p = numtheory.find_prime_with_orders(1, 1, bits)
+    return BasicShamirSharing(share_count=8, privacy_threshold=3,
+                              prime_modulus=p)
+
+
+def _round_bench(name, participants, dim, scheme=None):
     """Single-chip full-round throughput (configs 2 and 3)."""
     import jax
     import jax.numpy as jnp
     from sda_tpu.mesh import single_chip_round
     from sda_tpu.protocol import FullMasking
 
-    scheme = _scheme()
+    scheme = scheme if scheme is not None else _scheme()
     p = scheme.prime_modulus
     dev = jax.devices()[0]
     dim = _cpu_scaled_dim(dim)
@@ -221,7 +223,8 @@ def _round_bench(name, participants, dim):
     return {
         "config": name,
         "metric": f"secure-aggregation throughput ({participants} x {dim}, "
-                  f"Packed-Shamir n=8, full mask)",
+                  f"{type(scheme).__name__} n={scheme.output_size}, "
+                  f"full mask)",
         "value": round(participants * dim / per_round, 1),
         "unit": "shared-elements/sec/chip",
         "round_seconds_marginal": round(per_round, 5),
@@ -553,6 +556,8 @@ CONFIGS = {
     "readme-walkthrough": lambda: bench_readme_walkthrough(),
     "paillier-2048": lambda: bench_paillier_2048(),
     "packed-1m": lambda: _round_bench("packed-1m", 100, 999_999),
+    "basic-1m": lambda: _round_bench("basic-1m", 100, 999_999,
+                                     scheme=_basic_scheme()),
     "lenet-60k": lambda: _round_bench("lenet-60k", 1000, 59_999),
     "mobilenet-3.5m": lambda: _streaming_bench(
         "mobilenet-3.5m", 5000, 3_499_999,

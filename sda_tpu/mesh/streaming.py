@@ -172,6 +172,179 @@ def synthetic_block_provider(
     return get_block
 
 
+def _checkpoint_save(path, fingerprint, out, done_dims, di, pi,
+                     acc_shares, acc_mask):
+    """Atomic, crash-durable resume snapshot: npz to a temp file, fsync,
+    then rename — shared by the single-chip and pod streamed drivers."""
+    import os
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(
+                f, fingerprint=np.frombuffer(
+                    fingerprint.encode(), dtype=np.uint8),
+                out=out[:done_dims], done_dims=np.int64(done_dims),
+                di=np.int64(di), pi=np.int64(pi),
+                acc_shares=np.asarray(acc_shares),
+                acc_mask=np.asarray(acc_mask),
+            )
+            # data must reach stable storage BEFORE the rename lands, or a
+            # power loss leaves a truncated snapshot at the destination
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _checkpoint_load(path, fingerprint):
+    import os
+    import zipfile
+
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            if bytes(z["fingerprint"]).decode() != fingerprint:
+                return None  # different round/config: start fresh
+            return {k: z[k] for k in
+                    ("out", "done_dims", "di", "pi",
+                     "acc_shares", "acc_mask")}
+    except (OSError, ValueError, KeyError, EOFError, zipfile.BadZipFile):
+        return None  # unreadable/truncated snapshot: start fresh
+
+
+def _drive_stream(owner, participants, dimension, key, *, make_block,
+                  make_accs, fetch, checkpoint_path=None,
+                  checkpoint_every_chunks=16, restore_accs=None):
+    """THE streamed tile loop — one definition of the tile/key derivation
+    and of the checkpoint/resume state machine, shared by
+    StreamingAggregator, StreamedPod, and (via StreamedPod.drive_tiles)
+    the multihost driver. d-tiles outer, participant tiles inner, one
+    accumulate step per tile, one finale per d-tile; snapshots every
+    ``checkpoint_every_chunks`` chunks (0 = boundaries only) and at every
+    d-tile boundary, removed on completion. Mask windows and share
+    randomness depend on the tile indexing here — any change breaks
+    resume bit-identity.
+    """
+    if key is None:
+        from ..crypto.core import fresh_prng_key
+
+        key = fresh_prng_key()
+    pc, dc = owner.participants_chunk, owner.dim_chunk
+    out = np.empty(dimension, dtype=np.int64)
+    resume = None
+    fingerprint = None
+    if checkpoint_path is not None:
+        if jax.process_count() > 1:
+            raise ValueError(
+                "streamed checkpointing is single-process; multihost "
+                "rounds re-run from scratch (per-process snapshot "
+                "coordination is not implemented)"
+            )
+        fingerprint = owner._checkpoint_fingerprint(
+            participants, dimension, key)
+        resume = _checkpoint_load(checkpoint_path, fingerprint)
+        if resume is not None:
+            out[: int(resume["done_dims"])] = resume["out"]
+    # ground truth for callers recording resumed runs (e.g. benches)
+    owner.last_resumed = resume is not None
+    resume_di = int(resume["di"]) if resume is not None else -1
+    resume_pi = int(resume["pi"]) if resume is not None else 0
+    if restore_accs is None:
+        restore_accs = lambda aS, aM: (jnp.asarray(aS), jnp.asarray(aM))
+    empty = np.zeros((0,), owner._field.dtype)
+    for di, d0 in enumerate(range(0, dimension, dc)):
+        d1 = min(d0 + dc, dimension)
+        d_size = -(-(d1 - d0) // owner._grain) * owner._grain  # pad to grain
+        if resume is not None and di < resume_di:
+            continue  # completed tile: out prefix already restored
+        if resume is not None and di == resume_di and resume_pi > 0:
+            acc_shares, acc_mask = restore_accs(
+                resume["acc_shares"], resume["acc_mask"])
+            start_pi = resume_pi
+        else:
+            acc_shares, acc_mask = make_accs(d_size)
+            start_pi = 0
+        for pi, p0 in enumerate(range(0, participants, pc)):
+            if pi < start_pi:
+                continue  # chunk already folded into the snapshot accs
+            p1 = min(p0 + pc, participants)
+            with timed_phase("stream.feed"):
+                block = make_block(p0, p1, d0, d1, d_size)
+            step = owner._steps.get(block.shape)
+            if step is None:
+                step = owner._steps[block.shape] = owner._step_fn(block.shape)
+            with timed_phase("stream.dispatch"):
+                acc_shares, acc_mask = step(
+                    block, _tile_key(key, pi, di), key,
+                    jnp.int32(p0), jnp.int32(d0 // 8),
+                    acc_shares, acc_mask,
+                )
+            if (checkpoint_path is not None
+                    and checkpoint_every_chunks > 0
+                    and (pi + 1) % checkpoint_every_chunks == 0):
+                with timed_phase("stream.checkpoint"):
+                    _checkpoint_save(
+                        checkpoint_path, fingerprint, out, d0, di, pi + 1,
+                        np.asarray(acc_shares), np.asarray(acc_mask),
+                    )
+        # sync before the finale so stream.finale times the reconstruct
+        # (for pods: psum_scatter + all_gather + reconstruct) alone, not
+        # the queued accumulate backlog
+        with timed_phase("stream.steps_sync"):
+            jax.block_until_ready(acc_shares)
+        final = owner._finals.get(d_size)
+        if final is None:
+            final = owner._finals[d_size] = owner._final_fn(d_size)
+        with timed_phase("stream.finale"):
+            out[d0:d1] = fetch(final(acc_shares, acc_mask))[: d1 - d0]
+        if checkpoint_path is not None:
+            with timed_phase("stream.checkpoint"):
+                _checkpoint_save(checkpoint_path, fingerprint, out, d1,
+                                 di + 1, 0, empty, empty)
+    if checkpoint_path is not None:
+        import os
+
+        try:
+            os.unlink(checkpoint_path)  # round complete
+        except OSError:
+            pass
+    return out
+
+
+def _round_fingerprint(scheme, masking, participants, dimension, pc, dc,
+                       pallas, survivors, key, extra=None):
+    """sha256 over everything that determines a streamed round's bytes."""
+    import hashlib
+
+    from ..protocol.helpers import canonical_json
+
+    payload = {
+        "scheme": scheme.to_obj(),
+        "masking": masking.to_obj(),
+        "participants": int(participants),
+        "dimension": int(dimension),
+        "participants_chunk": int(pc),
+        "dim_chunk": int(dc),
+        "pallas": bool(pallas),
+        "survivors": survivors,
+        "key": np.asarray(
+            jax.random.key_data(key) if jnp.issubdtype(
+                getattr(key, "dtype", None), jax.dtypes.prng_key)
+            else key).tolist(),
+        **(extra or {}),
+    }
+    return hashlib.sha256(canonical_json(payload)).hexdigest()
+
+
 class StreamingAggregator:
     """Chunked single-chip rounds: fixed device memory for any P and d.
 
@@ -274,75 +447,15 @@ class StreamingAggregator:
     # uninterrupted one.
 
     def _checkpoint_fingerprint(self, participants, dimension, key):
-        import hashlib
+        return _round_fingerprint(
+            self.scheme, self.masking, participants, dimension,
+            self.participants_chunk, self.dim_chunk, self.pallas_active,
+            self.surviving_clerks, key,
+        )
 
-        from ..protocol.helpers import canonical_json
-
-        payload = {
-            "scheme": self.scheme.to_obj(),
-            "masking": self.masking.to_obj(),
-            "participants": int(participants),
-            "dimension": int(dimension),
-            "participants_chunk": self.participants_chunk,
-            "dim_chunk": self.dim_chunk,
-            "pallas": bool(self.pallas_active),
-            "survivors": self.surviving_clerks,
-            "key": np.asarray(
-                jax.random.key_data(key) if jnp.issubdtype(
-                    getattr(key, "dtype", None), jax.dtypes.prng_key)
-                else key).tolist(),
-        }
-        return hashlib.sha256(canonical_json(payload)).hexdigest()
-
-    @staticmethod
-    def _checkpoint_save(path, fingerprint, out, done_dims, di, pi,
-                         acc_shares, acc_mask):
-        """Atomic snapshot: npz to a temp file, then rename."""
-        import os
-        import tempfile
-
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
-                                   suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(
-                    f, fingerprint=np.frombuffer(
-                        fingerprint.encode(), dtype=np.uint8),
-                    out=out[:done_dims], done_dims=np.int64(done_dims),
-                    di=np.int64(di), pi=np.int64(pi),
-                    acc_shares=np.asarray(acc_shares),
-                    acc_mask=np.asarray(acc_mask),
-                )
-                # crash-durable: data must reach stable storage BEFORE the
-                # rename lands, or a power loss leaves a truncated snapshot
-                # at the destination path
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-
-    @staticmethod
-    def _checkpoint_load(path, fingerprint):
-        import os
-        import zipfile
-
-        if not os.path.exists(path):
-            return None
-        try:
-            with np.load(path) as z:
-                if bytes(z["fingerprint"]).decode() != fingerprint:
-                    return None  # different round/config: start fresh
-                return {k: z[k] for k in
-                        ("out", "done_dims", "di", "pi",
-                         "acc_shares", "acc_mask")}
-        except (OSError, ValueError, KeyError, EOFError,
-                zipfile.BadZipFile):
-            return None  # unreadable/truncated snapshot: start fresh
+    # back-compat aliases for the module-level snapshot helpers
+    _checkpoint_save = staticmethod(_checkpoint_save)
+    _checkpoint_load = staticmethod(_checkpoint_load)
 
     # -- driver ----------------------------------------------------------
     def aggregate_blocks(
@@ -361,99 +474,33 @@ class StreamingAggregator:
         one, is ignored, never trusted.
         """
         s = self.scheme
-        if key is None:
-            from ..crypto.core import fresh_prng_key
-
-            key = fresh_prng_key()
         acc_dtype = self._field.dtype
-        out = np.empty(dimension, dtype=np.int64)
-        resume = None
-        fingerprint = None
-        if checkpoint_path is not None:
-            fingerprint = self._checkpoint_fingerprint(
-                participants, dimension, key)
-            resume = self._checkpoint_load(checkpoint_path, fingerprint)
-            if resume is not None:
-                nd = int(resume["done_dims"])
-                out[:nd] = resume["out"]
-        #: whether the LAST aggregate_blocks call resumed from a snapshot
-        #: (ground truth for callers recording resumed runs, e.g. benches)
-        self.last_resumed = resume is not None
-        resume_di = int(resume["di"]) if resume is not None else -1
-        resume_pi = int(resume["pi"]) if resume is not None else 0
-        for di, d0 in enumerate(range(0, dimension, self.dim_chunk)):
-            d1 = min(d0 + self.dim_chunk, dimension)
-            d_size = d1 - d0
-            ds_pad = -(-d_size // self._grain) * self._grain  # edge tile
-            B = ds_pad // s.input_size
-            if resume is not None and di < resume_di:
-                continue  # completed tile: out[:done_dims] already restored
-            if resume is not None and di == resume_di and resume_pi > 0:
-                acc_shares = jnp.asarray(resume["acc_shares"])
-                acc_mask = jnp.asarray(resume["acc_mask"])
-                start_pi = resume_pi
-            else:
-                acc_shares = jnp.zeros((s.output_size, B), acc_dtype)
-                acc_mask = jnp.zeros((ds_pad,), acc_dtype)
-                start_pi = 0
-            for pi, p0 in enumerate(range(0, participants, self.participants_chunk)):
-                if pi < start_pi:
-                    continue  # chunk already folded into the snapshot accs
-                p1 = min(p0 + self.participants_chunk, participants)
-                with timed_phase("stream.feed"):
-                    raw = get_block(p0, p1, d0, d1)
-                    if isinstance(raw, jax.Array):
-                        # device-generated block: pad on device, no host hop
-                        block = (raw if ds_pad == d_size else
-                                 jnp.pad(raw, ((0, 0), (0, ds_pad - d_size))))
-                    else:
-                        host = np.asarray(raw)
-                        if ds_pad != d_size:  # zero columns sum to zero
-                            padded = np.zeros((host.shape[0], ds_pad),
-                                              dtype=host.dtype)
-                            padded[:, :d_size] = host
-                            host = padded
-                        block = jnp.asarray(host)
-                bkey = _tile_key(key, pi, di)
-                step = self._steps.get(block.shape)
-                if step is None:
-                    step = self._steps[block.shape] = self._step_fn(block.shape)
-                with timed_phase("stream.dispatch"):
-                    acc_shares, acc_mask = step(
-                        block, bkey, key, jnp.int32(p0), jnp.int32(d0 // 8),
-                        acc_shares, acc_mask,
-                    )
-                if (checkpoint_path is not None
-                        and checkpoint_every_chunks > 0
-                        and (pi + 1) % checkpoint_every_chunks == 0):
-                    with timed_phase("stream.checkpoint"):
-                        self._checkpoint_save(
-                            checkpoint_path, fingerprint, out, d0, di, pi + 1,
-                            np.asarray(acc_shares), np.asarray(acc_mask),
-                        )
-            # sync before the finale so stream.finale times the collective
-            # reconstruct alone, not the queued accumulate backlog
-            with timed_phase("stream.steps_sync"):
-                jax.block_until_ready(acc_shares)
-            final = self._finals.get(ds_pad)
-            if final is None:
-                final = self._finals[ds_pad] = self._final_fn(ds_pad)
-            with timed_phase("stream.finale"):
-                out[d0:d1] = np.asarray(final(acc_shares, acc_mask))[:d_size]
-            if checkpoint_path is not None:
-                with timed_phase("stream.checkpoint"):
-                    self._checkpoint_save(
-                        checkpoint_path, fingerprint, out, d1, di + 1, 0,
-                        np.zeros((0,), acc_dtype), np.zeros((0,), acc_dtype),
-                    )
-        if checkpoint_path is not None:
-            import os
 
-            try:
-                os.unlink(checkpoint_path)  # round complete
-            except OSError:
-                pass
-        return out
+        def make_block(p0, p1, d0, d1, d_size):
+            raw = get_block(p0, p1, d0, d1)
+            real = d1 - d0
+            if isinstance(raw, jax.Array):
+                # device-generated block: pad on device, no host hop
+                return (raw if d_size == real else
+                        jnp.pad(raw, ((0, 0), (0, d_size - real))))
+            host = np.asarray(raw)
+            if d_size != real:  # zero columns sum to zero
+                padded = np.zeros((host.shape[0], d_size), dtype=host.dtype)
+                padded[:, :real] = host
+                host = padded
+            return jnp.asarray(host)
+
+        def make_accs(d_size):
+            B = d_size // s.input_size
+            return (jnp.zeros((s.output_size, B), acc_dtype),
+                    jnp.zeros((d_size,), acc_dtype))
+
+        return _drive_stream(
+            self, participants, dimension, key,
+            make_block=make_block, make_accs=make_accs, fetch=np.asarray,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every_chunks=checkpoint_every_chunks,
+        )
 
     def aggregate(self, inputs, key=None) -> np.ndarray:
         inputs = np.asarray(inputs)
@@ -610,9 +657,17 @@ class StreamedPod:
 
     # -- driver ----------------------------------------------------------
     def aggregate_blocks(
-        self, get_block: BlockProvider, participants: int, dimension: int, key=None
+        self, get_block: BlockProvider, participants: int, dimension: int,
+        key=None, *, checkpoint_path: Optional[str] = None,
+        checkpoint_every_chunks: int = 16,
     ) -> np.ndarray:
-        """Stream all blocks; returns the [dimension] aggregate (host array)."""
+        """Stream all blocks; returns the [dimension] aggregate (host array).
+
+        ``checkpoint_path``: same atomic snapshot / bit-identical resume
+        contract as StreamingAggregator (single-process; the fingerprint
+        additionally pins the mesh shape). Loaded accumulators are
+        re-placed onto the mesh with the pod's ('p', 'd') sharding.
+        """
         sharding = NamedSharding(self.mesh, P("p", "d"))
 
         def make_block(p0, p1, d0, d1, d_size):
@@ -631,15 +686,34 @@ class StreamedPod:
                 host = padded
             return jax.device_put(jnp.asarray(host), sharding)
 
+        def restore_accs(acc_shares_np, acc_mask_np):
+            return (
+                jax.device_put(jnp.asarray(acc_shares_np), sharding),
+                jax.device_put(jnp.asarray(acc_mask_np), sharding),
+            )
+
         return self.drive_tiles(
             participants, dimension, key,
             make_block=make_block, make_accs=self._new_accs,
             fetch=np.asarray,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every_chunks=checkpoint_every_chunks,
+            restore_accs=restore_accs,
+        )
+
+    def _checkpoint_fingerprint(self, participants, dimension, key):
+        return _round_fingerprint(
+            self.scheme, self.masking, participants, dimension,
+            self.participants_chunk, self.dim_chunk, self.pallas_active,
+            self.surviving_clerks, key,
+            extra={"mesh": list(self.mesh.devices.shape)},
         )
 
     def drive_tiles(
         self, participants: int, dimension: int, key,
         *, make_block, make_accs, fetch,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every_chunks: int = 16, restore_accs=None,
     ) -> np.ndarray:
         """The tile loop shared by single-host streaming and the multihost
         driver (mesh/multihost.py): d-tiles outer, participant tiles inner,
@@ -650,41 +724,19 @@ class StreamedPod:
         the zeroed (shares, mask) accumulators; ``fetch(arr)`` brings a
         d-sharded finale result to host numpy. The tile/key derivation here
         is THE definition — mask windows and share randomness depend on it.
-        """
-        if key is None:
-            from ..crypto.core import fresh_prng_key
 
-            key = fresh_prng_key()
-        pc, dc = self.participants_chunk, self.dim_chunk
-        out = np.empty(dimension, dtype=np.int64)
-        for di_ix, d0 in enumerate(range(0, dimension, dc)):
-            d1 = min(d0 + dc, dimension)
-            d_size = -(-(d1 - d0) // self._grain) * self._grain  # pad to grain
-            acc_shares, acc_mask = make_accs(d_size)
-            for pi_ix, p0 in enumerate(range(0, participants, pc)):
-                p1 = min(p0 + pc, participants)
-                with timed_phase("stream.feed"):
-                    block = make_block(p0, p1, d0, d1, d_size)
-                step = self._steps.get((pc, d_size))
-                if step is None:
-                    step = self._steps[(pc, d_size)] = self._step_fn((pc, d_size))
-                with timed_phase("stream.dispatch"):
-                    acc_shares, acc_mask = step(
-                        block, _tile_key(key, pi_ix, di_ix), key,
-                        jnp.int32(p0), jnp.int32(d0 // 8),
-                        acc_shares, acc_mask,
-                    )
-            # sync before the finale so stream.finale times the collective
-            # (psum_scatter + all_gather + reconstruct) alone, not the
-            # queued accumulate backlog
-            with timed_phase("stream.steps_sync"):
-                jax.block_until_ready(acc_shares)
-            final = self._finals.get(d_size)
-            if final is None:
-                final = self._finals[d_size] = self._final_fn(d_size)
-            with timed_phase("stream.finale"):
-                out[d0:d1] = fetch(final(acc_shares, acc_mask))[: d1 - d0]
-        return out
+        ``checkpoint_path`` (single-process only): same atomic snapshot /
+        bit-identical resume contract as StreamingAggregator;
+        ``restore_accs(acc_shares_np, acc_mask_np)`` re-places loaded host
+        accumulators onto the mesh (defaults to plain ``jnp.asarray``).
+        """
+        return _drive_stream(
+            self, participants, dimension, key,
+            make_block=make_block, make_accs=make_accs, fetch=fetch,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every_chunks=checkpoint_every_chunks,
+            restore_accs=restore_accs,
+        )
 
     def aggregate(self, inputs, key=None) -> np.ndarray:
         inputs = np.asarray(inputs)

@@ -289,3 +289,53 @@ def test_streaming_checkpoint_rejects_foreign_snapshot(tmp_path):
                                  checkpoint_path=ck)
     exp = agg().aggregate_blocks(prov, 23, 100, jax.random.PRNGKey(8))
     np.testing.assert_array_equal(out, exp)
+
+
+@needs8
+def test_streamed_pod_checkpoint_resume_bit_identical(tmp_path):
+    """StreamedPod (multi-chip) rounds resume from snapshots too: the
+    fingerprint additionally pins the mesh shape, and loaded accumulators
+    are re-placed with the pod's ('p', 'd') sharding."""
+    import os
+
+    from sda_tpu.mesh import StreamedPod, synthetic_block_provider32
+    from sda_tpu.mesh.simpod import make_mesh
+
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    s = PackedShamirSharing(3, 8, t, p, w2, w3)
+    key = jax.random.PRNGKey(9)
+    prov = synthetic_block_provider32(p, seed=6, max_value=1 << 20)
+    ck = str(tmp_path / "pod.ckpt.npz")
+
+    def pod():
+        return StreamedPod(s, FullMasking(p), mesh=make_mesh(4, 2),
+                           participants_chunk=8, dim_chunk=24)
+
+    ref = pod().aggregate_blocks(prov, 21, 96, key)
+
+    calls = {"n": 0}
+
+    def flaky(p0, p1, d0, d1):
+        calls["n"] += 1
+        if calls["n"] == 8:
+            raise RuntimeError("crash")
+        return prov(p0, p1, d0, d1)
+
+    with pytest.raises(RuntimeError):
+        pod().aggregate_blocks(flaky, 21, 96, key, checkpoint_path=ck,
+                               checkpoint_every_chunks=2)
+    assert os.path.exists(ck)
+
+    counting = {"n": 0}
+
+    def cprov(p0, p1, d0, d1):
+        counting["n"] += 1
+        return prov(p0, p1, d0, d1)
+
+    resumed = pod()
+    out = resumed.aggregate_blocks(cprov, 21, 96, key, checkpoint_path=ck,
+                                   checkpoint_every_chunks=2)
+    assert resumed.last_resumed
+    np.testing.assert_array_equal(out, ref)
+    assert not os.path.exists(ck)
+    assert counting["n"] < 12  # resume skipped folded chunks
