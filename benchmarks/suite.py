@@ -276,7 +276,10 @@ def _e2e_streamed_run(agg, prov_host, prov_dev, participants_run, dim,
         "coverage_of_target": round(
             participants_run / participants_target, 4),
         "wall_seconds": round(wall, 3),
-        "elements_per_sec": round(elements / wall, 1),
+        # a resumed run's wall covers only the remainder — a full-round
+        # rate derived from it would be inflated, so none is emitted
+        **({} if resumed else
+           {"elements_per_sec": round(elements / wall, 1)}),
         "device_generated_inputs": device_generated,
         "finale_seconds": round(fin.get("total_s", 0.0), 4),
         "finale_count": fin.get("count", 0),

@@ -179,7 +179,12 @@ def main(argv=None) -> int:
                   file=sys.stderr)
         p = numtheory.find_prime_with_orders(1, 1, args.modulus_bits)
         t = max(1, (args.clerks - 1) // 2)  # honest majority
-        scheme = BasicShamirSharing(args.clerks, t, p)
+        try:
+            scheme = BasicShamirSharing(args.clerks, t, p)
+        except ValueError as e:
+            print(f"error: {e} (--clerks {args.clerks} cannot form a "
+                  f"basic-shamir committee)", file=sys.stderr)
+            return 1
     else:
         k = args.secrets_per_batch if args.secrets_per_batch is not None else 3
         t, p, w2, w3 = numtheory.generate_packed_params(

@@ -46,15 +46,17 @@ def mod_combine(vectors: Sequence[np.ndarray], modulus: int) -> np.ndarray:
     vecs = [np.asarray(v, dtype=np.int64) for v in vectors]
     if not vecs:
         return np.zeros(0, dtype=np.int64)
-    # Canonicalize before summing: the overflow-exact chunking in modsum /
-    # np_modsum derives its fan from the modulus and assumes residues in
-    # [0, m). Fresh shares satisfy that, but Paillier-premixed clerk batches
-    # decrypt to UNREDUCED sums (encryption.py PackedPaillierDecryptor), and
-    # at wide component windows those could wrap an int64 partial sum.
-    stacked = np.stack(vecs) % modulus
+    stacked = np.stack(vecs)
     if _small(stacked.size):
+        # oracle.combine canonicalizes internally — no second % pass
         return oracle.combine(stacked, modulus)
-    return np.asarray(fields.combine(jnp.asarray(stacked), modulus=modulus))
+    # Canonicalize before the device sum: modsum's overflow-exact chunking
+    # derives its fan from the modulus and assumes residues in [0, m).
+    # Fresh shares satisfy that, but Paillier-premixed clerk batches
+    # decrypt to UNREDUCED sums (encryption.py PackedPaillierDecryptor),
+    # and at wide component windows those could wrap an int64 partial sum.
+    return np.asarray(fields.combine(jnp.asarray(stacked % modulus),
+                                     modulus=modulus))
 
 
 class ShareGenerator:

@@ -130,14 +130,19 @@ def synthetic_device_block_provider32(
 
     import functools
 
-    @functools.partial(jax.jit, static_argnames=("p0", "p1", "d0", "d1"))
-    def gen(p0, p1, d0, d1):
-        rows = jnp.arange(p0, p1, dtype=jnp.uint32)[:, None]
-        cols = jnp.arange(d0, d1, dtype=jnp.uint32)[None, :]
-        return _hash32(rows, cols, jnp.uint32(sd), jnp) % jnp.uint32(bound)
+    # only the SHAPE is static: tile offsets are traced operands, so the
+    # generator compiles once per block shape (2-3 shapes per run), not
+    # once per tile — a flagship run has hundreds of distinct offsets and
+    # per-tile retraces would feed serial compile time into the timed span
+    @functools.partial(jax.jit, static_argnames=("rows", "cols"))
+    def gen(p0, d0, *, rows, cols):
+        r = p0 + jnp.arange(rows, dtype=jnp.uint32)[:, None]
+        c = d0 + jnp.arange(cols, dtype=jnp.uint32)[None, :]
+        return _hash32(r, c, jnp.uint32(sd), jnp) % jnp.uint32(bound)
 
     def get_block(p0, p1, d0, d1):
-        return gen(p0=int(p0), p1=int(p1), d0=int(d0), d1=int(d1))
+        return gen(jnp.uint32(p0), jnp.uint32(d0),
+                   rows=int(p1 - p0), cols=int(d1 - d0))
 
     return get_block
 
@@ -196,6 +201,18 @@ def _checkpoint_save(path, fingerprint, out, done_dims, di, pi,
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        # ... and the rename itself must reach the journal: fsync the
+        # containing directory, else a crash can roll back to the prior
+        # snapshot (harmless to correctness, but the durability claim
+        # would be false)
+        try:
+            dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # platform without directory fsync
     except BaseException:
         try:
             os.unlink(tmp)

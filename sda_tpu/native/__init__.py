@@ -27,17 +27,17 @@ _build_failed = False
 
 
 def _compile() -> bool:
-    base = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17"]
-    # -march=native speeds the __int128 Montgomery ladder measurably; retry
-    # portable flags if the host compiler rejects it
-    for extra in (["-march=native", "-mtune=native"], []):
-        try:
-            subprocess.run(base + extra + [str(_SRC), "-o", str(_LIB_PATH)],
-                           check=True, capture_output=True, timeout=120)
-            return True
-        except (OSError, subprocess.SubprocessError):
-            continue
-    return False
+    # portable codegen only: a -march=native .so cached in the package
+    # directory SIGILLs (uncatchable) if the directory later moves to a
+    # CPU without those ISA extensions, and it measured no speedup for
+    # the __int128 Montgomery ladder anyway
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+           str(_SRC), "-o", str(_LIB_PATH)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
 
 
 def _load() -> Optional[ctypes.CDLL]:

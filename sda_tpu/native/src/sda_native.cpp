@@ -297,6 +297,16 @@ static void mont_rr(const uint64_t* n, int64_t nl, uint64_t* rr) {
 // (base < n), exp has el limbs, n odd with n[nl-1] != 0. Fixed 4-bit
 // window. scratch must hold 22 * nl + 3 limbs; pass null to have the
 // function refuse (keeps the ABI allocation-free).
+//
+// NOT constant-time: the ladder skips leading zero windows, multiplies
+// only on nonzero windows, and the Montgomery reductions take
+// data-dependent conditional subtracts — execution time leaks the
+// exponent's zero-window pattern and effective bit length. CPython's
+// pow (the fallback path) is variable-time too. Acceptable for this
+// repo's threat model (Paillier decrypt runs on the clerk's own
+// machine; the wire carries ciphertexts, not timings), but a deployment
+// where an adversary can time individual decryptions at high resolution
+// should use a constant-time bignum library instead. See docs/crypto.md.
 int sda_powmod(const uint64_t* base, const uint64_t* exp, int64_t el,
                const uint64_t* n, int64_t nl, uint64_t* scratch,
                uint64_t* out) {
