@@ -1,0 +1,39 @@
+"""Model layer: float models in, secure aggregates out.
+
+Completes the reference's federated-ML story (README.md:3-15) with the
+pieces it leaves to the application: fixed-point encoding into Z_m,
+flax model families sized to the benchmark workloads, and FedAvg driven
+through either the real protocol or the TPU mesh fast path.
+
+The flax-backed families load lazily (PEP 562) so the codec and the
+federated drivers work on installs without flax/optax.
+"""
+
+from .encoding import FixedPointCodec, ravel_pytree
+from .federated import FederatedSession, LocalTrainer, pod_fedavg_round
+
+_FAMILY_EXPORTS = (
+    "LeNet",
+    "MobileLite",
+    "LoRAMLP",
+    "lora_adapter_params",
+    "merge_lora_params",
+    "param_count",
+)
+
+__all__ = [
+    "FixedPointCodec",
+    "ravel_pytree",
+    "FederatedSession",
+    "LocalTrainer",
+    "pod_fedavg_round",
+    *_FAMILY_EXPORTS,
+]
+
+
+def __getattr__(name):
+    if name in _FAMILY_EXPORTS:
+        from . import families
+
+        return getattr(families, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,184 @@
+"""Fixed-point encoding of float model vectors into Z_m.
+
+The reference aggregates i64 vectors and leaves the float<->integer story
+to the application ("combining locally trained machine learning models",
+reference README.md:3-15; `Secret = i64`, client/src/crypto/mod.rs:33-36).
+This module owns that story for the TPU build: a deterministic fixed-point
+codec whose central guarantee is *exactness of the aggregate* — the secure
+modular sum of encodings decodes to the exact sum of the quantized client
+values, provided the configured summand capacity is respected.
+
+Centered representation: a quantized value q in [-Q, Q] is uploaded as
+q mod m. Sums stay decodable while |sum q_i| < m/2, so the codec derives
+its clip range from (modulus, fractional_bits, max_summands) and refuses
+configurations that could wrap. This mirrors the headroom discipline the
+reference leaves implicit (values "assumed small enough", sharing/
+additive.rs:37-39) but makes it a checked, documented contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["FixedPointCodec", "ravel_pytree"]
+
+
+def ravel_pytree(tree):
+    """Flatten a pytree of float arrays to one float64 numpy vector.
+
+    Returns (vector, unravel) where unravel maps a same-length float vector
+    back to the original structure/shapes/dtypes. This is the TPU analog of
+    the reference's "the model IS the vector" convention (README.md:3-15):
+    one participation carries one flattened model (or model delta).
+    """
+    import jax
+    from jax import numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [np.shape(l) for l in leaves]
+    dtypes = [np.asarray(l).dtype for l in leaves]
+    sizes = [int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes]
+    vec = np.concatenate(
+        [np.asarray(l, dtype=np.float64).reshape(-1) for l in leaves]
+    ) if leaves else np.zeros((0,), np.float64)
+
+    def unravel(flat):
+        flat = np.asarray(flat, dtype=np.float64)
+        if flat.shape != vec.shape:
+            raise ValueError(f"expected shape {vec.shape}, got {flat.shape}")
+        out, off = [], 0
+        for shape, dtype, size in zip(shapes, dtypes, sizes):
+            chunk = flat[off:off + size].reshape(shape).astype(dtype)
+            out.append(jnp.asarray(chunk))
+            off += size
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return vec, unravel
+
+
+class FixedPointCodec:
+    """Deterministic fixed-point codec float -> Z_m with summand capacity.
+
+    Parameters
+    ----------
+    modulus:
+        The aggregation modulus m (additive scheme modulus or the Shamir
+        prime; resources.rs:44-67 carries it in-band in the Aggregation).
+    fractional_bits:
+        Scale = 2**fractional_bits. Quantization step is 2**-fractional_bits.
+    max_summands:
+        Largest number of vectors that will ever be summed under one
+        aggregation (participants; clerk partial sums never exceed this).
+        The decodable band is |sum| < m/2, so per-value magnitude is capped
+        at clip = floor((m//2 - 1) / max_summands) / scale.
+    clip:
+        Optional tighter magnitude bound (floats are clamped to [-clip, clip]
+        before quantization). Must not exceed the capacity-derived bound.
+    """
+
+    __slots__ = ("modulus", "fractional_bits", "scale", "max_summands",
+                 "clip", "_q_max")
+
+    def __init__(self, modulus: int, fractional_bits: int, max_summands: int,
+                 clip: Optional[float] = None):
+        modulus = int(modulus)
+        if modulus < 3:
+            raise ValueError("modulus must be >= 3")
+        if max_summands < 1:
+            raise ValueError("max_summands must be >= 1")
+        self.modulus = modulus
+        self.fractional_bits = int(fractional_bits)
+        self.scale = float(1 << self.fractional_bits)
+        self.max_summands = int(max_summands)
+        q_cap = (modulus // 2 - 1) // self.max_summands
+        if q_cap < 1:
+            raise ValueError(
+                f"modulus {modulus} has no headroom for {max_summands} "
+                f"summands: increase the modulus or lower max_summands"
+            )
+        cap = q_cap / self.scale
+        if clip is None:
+            clip = cap
+        elif clip > cap:
+            raise ValueError(
+                f"clip {clip} exceeds the exactness capacity {cap:.6g} "
+                f"(modulus {modulus}, {max_summands} summands, "
+                f"{self.fractional_bits} fractional bits)"
+            )
+        elif clip <= 0:
+            raise ValueError("clip must be positive")
+        self.clip = float(clip)
+        self._q_max = int(round(self.clip * self.scale))
+
+    # -- host (numpy) path -------------------------------------------------
+
+    def quantize(self, x) -> np.ndarray:
+        """Float array -> signed quantized int64 in [-q_max, q_max].
+
+        Quantization happens in float32 — the same arithmetic the device
+        path uses — so host and device encodings are bit-identical (both
+        numpy and XLA round half to even).
+        """
+        x32 = np.clip(np.asarray(x, dtype=np.float32),
+                      np.float32(-self.clip), np.float32(self.clip))
+        q = np.rint(x32 * np.float32(self.scale)).astype(np.int64)
+        return np.clip(q, -self._q_max, self._q_max)
+
+    def encode(self, x) -> np.ndarray:
+        """Float array -> representatives in [0, modulus) ready to share."""
+        return np.mod(self.quantize(x), self.modulus).astype(np.int64)
+
+    def decode_sum(self, values, summands: int = 1) -> np.ndarray:
+        """Aggregate in [0, m) -> exact float sum of the quantized inputs.
+
+        ``summands`` is checked against the configured capacity; the lift is
+        centered, matching RecipientOutput.positive()'s canonical band
+        (receive.rs:14-21) shifted to (-m/2, m/2].
+        """
+        if summands > self.max_summands:
+            raise ValueError(
+                f"{summands} summands exceeds configured capacity "
+                f"{self.max_summands}; the sum may have wrapped"
+            )
+        v = np.mod(np.asarray(values, dtype=np.int64), self.modulus)
+        half = self.modulus // 2
+        centered = v - np.where(v > half, self.modulus, 0)
+        return centered.astype(np.float64) / self.scale
+
+    def decode_mean(self, values, summands: int) -> np.ndarray:
+        return self.decode_sum(values, summands) / float(summands)
+
+    # -- device (jnp) path -------------------------------------------------
+
+    def encode_device(self, x):
+        """jnp float array -> int32 residues in [0, m), jit-friendly.
+
+        Matches the host ``encode`` bit-for-bit: both paths clip, scale,
+        and round in float32 (half-to-even). Requires clip * scale within
+        float32's exact-integer range (2^24) so the rounded product is
+        representable — the constructor's capacity rule keeps realistic
+        FedAvg configs far below that. Output dtype is int32 (modulus <
+        2^31 per fields/numtheory.py's device-limb constraint) so it feeds
+        the pod/streamed paths directly.
+        """
+        from jax import numpy as jnp
+
+        if self._q_max > (1 << 24):
+            raise ValueError(
+                f"q_max {self._q_max} exceeds float32's exact-integer range; "
+                "use the host encode() for this configuration"
+            )
+        xc = jnp.clip(jnp.asarray(x, jnp.float32),
+                      jnp.float32(-self.clip), jnp.float32(self.clip))
+        q = jnp.round(xc * jnp.float32(self.scale)).astype(jnp.int32)
+        q = jnp.clip(q, -self._q_max, self._q_max)
+        return jnp.where(q < 0, q + self.modulus, q).astype(jnp.int32)
+
+    # -- misc ----------------------------------------------------------------
+
+    def __repr__(self):
+        return (f"FixedPointCodec(modulus={self.modulus}, "
+                f"fractional_bits={self.fractional_bits}, "
+                f"max_summands={self.max_summands}, clip={self.clip:.6g})")

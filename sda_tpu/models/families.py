@@ -1,0 +1,149 @@
+"""Model families sized to the benchmark workloads (BASELINE.json).
+
+The reference's use case is aggregating *locally trained models*
+(README.md:3-15) but it ships no model code — vectors arrive pre-flattened.
+The TPU build completes the story with three flax families matching the
+benchmark vector sizes, so the end-to-end demos and benches aggregate real
+trainable parameter vectors rather than synthetic ints:
+
+- ``LeNet``        — the classic 28x28 convnet, ~61k params (lenet-60k).
+- ``MobileLite``   — depthwise-separable inverted-residual stack; at the
+                     default width it lands ~3.5M params (mobilenet-3.5m).
+- ``LoRAMLP``      — a frozen wide MLP with trainable rank-r adapters; the
+                     *adapter* vector is what gets aggregated (lora-13m).
+
+Every family is an ordinary flax module: ``init`` / ``apply`` compose with
+jit, vmap, and the mesh shardings like any other JAX model.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+__all__ = ["LeNet", "MobileLite", "LoRAMLP", "lora_adapter_params",
+           "merge_lora_params", "param_count"]
+
+
+def param_count(params) -> int:
+    """Total leaf elements; works on arrays and eval_shape structs alike."""
+    total = 0
+    for p in jax.tree_util.tree_leaves(params):
+        shape = p.shape if hasattr(p, "shape") else np.shape(p)
+        total += int(np.prod(shape, dtype=np.int64))
+    return total
+
+
+class LeNet(nn.Module):
+    """LeNet-5-shaped convnet for 28x28x1 inputs (~61k params)."""
+
+    num_classes: int = 10
+    width: int = 1  # multiplier, lets tests shrink the family
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.width
+        x = nn.Conv(6 * w, (5, 5), padding="SAME")(x)
+        x = nn.relu(nn.avg_pool(x, (2, 2), (2, 2)))
+        x = nn.Conv(16 * w, (5, 5), padding="VALID")(x)
+        x = nn.relu(nn.avg_pool(x, (2, 2), (2, 2)))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120 * w)(x))
+        x = nn.relu(nn.Dense(84 * w)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class _InvertedResidual(nn.Module):
+    """MobileNetV2-style expand -> depthwise -> project block."""
+
+    channels: int
+    expand: int = 4
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        cin = x.shape[-1]
+        h = nn.Conv(cin * self.expand, (1, 1), use_bias=False)(x)
+        h = nn.relu6(nn.GroupNorm(num_groups=1)(h))
+        h = nn.Conv(cin * self.expand, (3, 3), strides=(self.stride,) * 2,
+                    feature_group_count=cin * self.expand, use_bias=False,
+                    padding="SAME")(h)
+        h = nn.relu6(nn.GroupNorm(num_groups=1)(h))
+        h = nn.Conv(self.channels, (1, 1), use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=1)(h)
+        if self.stride == 1 and cin == self.channels:
+            h = h + x
+        return h
+
+
+class MobileLite(nn.Module):
+    """Depthwise-separable convnet in the MobileNetV2 spirit.
+
+    The default (width=40, blocks below) initializes to ~3.7M parameters for
+    32x32x3 inputs — the mobilenet-3.5m benchmark vector. GroupNorm stands in
+    for BatchNorm so a participant's update is a pure function of its local
+    batch (no running statistics to aggregate out-of-band).
+    """
+
+    num_classes: int = 10
+    width: int = 40
+    block_channels: Sequence[int] = (16, 24, 40, 80, 112, 192, 320)
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.width
+        x = nn.Conv(w, (3, 3), strides=(2, 2), use_bias=False, padding="SAME")(x)
+        x = nn.relu6(nn.GroupNorm(num_groups=1)(x))
+        for i, c in enumerate(self.block_channels):
+            stride = 2 if i in (1, 2, 4) else 1
+            x = _InvertedResidual(channels=c * w // 32, stride=stride)(x)
+            x = _InvertedResidual(channels=c * w // 32)(x)
+        x = nn.Conv(40 * w, (1, 1), use_bias=False)(x)
+        x = nn.relu6(nn.GroupNorm(num_groups=1)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class LoRAMLP(nn.Module):
+    """Wide MLP whose Dense kernels carry rank-r LoRA adapters.
+
+    Aggregation-relevant split: the *base* params are frozen and identical
+    on every participant; only the adapter params (A, B per layer) are
+    trained and securely aggregated. ``lora_adapter_params`` extracts that
+    trainable sub-tree; at (features=4096, layers=4, rank=400) the adapter
+    vector is ~13.1M params — the lora-13m benchmark workload.
+    """
+
+    features: int = 4096
+    layers: int = 4
+    rank: int = 400
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for i in range(self.layers):
+            dense = nn.Dense(self.features, name=f"base_{i}")
+            a = self.param(f"lora_a_{i}", nn.initializers.normal(0.02),
+                           (x.shape[-1], self.rank))
+            b = self.param(f"lora_b_{i}", nn.initializers.zeros,
+                           (self.rank, self.features))
+            x = nn.relu(dense(x) + (x @ a) @ b)
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+def lora_adapter_params(params) -> dict:
+    """The trainable (aggregated) sub-tree of a LoRAMLP param tree."""
+    tree = params["params"] if "params" in params else params
+    return {k: v for k, v in tree.items() if k.startswith("lora_")}
+
+
+def merge_lora_params(params, adapters) -> dict:
+    """Rebuild a full param tree from frozen base + aggregated adapters."""
+    tree = dict(params["params"] if "params" in params else params)
+    tree.update(adapters)
+    return {"params": tree} if "params" in params else tree
