@@ -212,24 +212,30 @@ def main() -> int:
         for p_block in (8, 16, 32, 64):
             for tile in (1024, 2048, 4096):
                 point = {"p_block": p_block, "tile": tile}
-                try:
-                    fn = jax.jit(single_chip_round_pallas(
-                        scheme, FullMasking(p), p_block=p_block, tile=tile))
-                    out = jax.device_get(fn(big, key))
-                    if not np.array_equal(out, expected_big):
-                        _emit("sweep", **point, ok=False, error="inexact")
-                        continue
-                    per, _info = marginal_seconds(
-                        lambda i: fn(big, jax.random.fold_in(key, i)),
-                        target_seconds=4,
-                    )
-                    point["gel_per_sec"] = round(P * d / per / 1e9, 2)
-                    _emit("sweep", **point, ok=True)
-                    if best is None or point["gel_per_sec"] > best["gel_per_sec"]:
-                        best = point
-                except Exception as e:
-                    _emit("sweep", **point, ok=False,
-                          error=f"{type(e).__name__}: {str(e)[:200]}")
+                # one retry per point: the remote_compile helper behind the
+                # tunnel throws transient HTTP 500s (observed round 3) and a
+                # single blip must not drop a knob from the sweep
+                for attempt in (0, 1):
+                    try:
+                        fn = jax.jit(single_chip_round_pallas(
+                            scheme, FullMasking(p), p_block=p_block, tile=tile))
+                        out = jax.device_get(fn(big, key))
+                        if not np.array_equal(out, expected_big):
+                            _emit("sweep", **point, ok=False, error="inexact")
+                            break
+                        per, _info = marginal_seconds(
+                            lambda i: fn(big, jax.random.fold_in(key, i)),
+                            target_seconds=4,
+                        )
+                        point["gel_per_sec"] = round(P * d / per / 1e9, 2)
+                        _emit("sweep", **point, ok=True, attempt=attempt)
+                        if best is None or point["gel_per_sec"] > best["gel_per_sec"]:
+                            best = point
+                        break
+                    except Exception as e:
+                        if attempt == 1:
+                            _emit("sweep", **point, ok=False,
+                                  error=f"{type(e).__name__}: {str(e)[:200]}")
         if best is not None:
             _emit("sweep_best", **best)
             # streamed-step A/B on chip (round-2 verdict #4 'done'
@@ -297,15 +303,25 @@ def main() -> int:
                        # full-coverage streamed e2e rounds (every dim tile,
                        # finale included) in the same hardware window
                        SDA_BENCH_FULL="1")
-            r = subprocess.run(
-                [sys.executable,
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "suite.py")],
-                env=env, timeout=float(os.environ.get("SDA_HW_SUITE_TIMEOUT",
-                                                      1800)),
-            )
-            _emit("suite_rerecord", rc=r.returncode, knobs=best)
-            ok = ok and r.returncode == 0
+            # suite.py re-records BENCH_SUITE.json incrementally (after
+            # every config), so even a timeout here keeps what finished;
+            # the full-coverage streamed configs need the longer budget
+            try:
+                r = subprocess.run(
+                    [sys.executable,
+                     os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "suite.py")],
+                    env=env,
+                    timeout=float(os.environ.get("SDA_HW_SUITE_TIMEOUT",
+                                                 3600)),
+                )
+                _emit("suite_rerecord", rc=r.returncode, knobs=best)
+                ok = ok and r.returncode == 0
+            except subprocess.TimeoutExpired:
+                _emit("suite_rerecord", rc=None, knobs=best,
+                      error="suite timeout; completed configs were "
+                            "re-recorded incrementally")
+                ok = False
     return 0 if ok else 1
 
 
@@ -387,25 +403,30 @@ def watch(interval_s: float, probe_timeout_s: float, max_hours: float) -> int:
             env = dict(os.environ, SDA_HW_FULL="1")
             out, rc = _run_group(
                 [sys.executable, os.path.abspath(__file__)], env,
-                float(os.environ.get("SDA_HW_WINDOW_TIMEOUT", 3600)))
+                float(os.environ.get("SDA_HW_WINDOW_TIMEOUT", 7200)))
             if rc is None:
                 record({"event": "full_run", "rc": None,
-                        "error": "window timeout; tunnel likely died mid-run"})
-                full_ok = False
+                        "error": "window timeout; tunnel likely died mid-run",
+                        "stages": _json_lines(out)})
             else:
                 record({"event": "full_run", "rc": rc,
                         "stages": _json_lines(out)})
-                full_ok = rc == 0
-            if full_ok:
-                bout, brc = _run_group(
-                    [sys.executable, os.path.join(repo, "bench.py")],
-                    dict(os.environ), 1800)
-                results = _json_lines(bout)
-                result = results[-1] if results else None
-                record({"event": "bench", "rc": brc, "result": result})
-                if brc == 0 and result and result.get("platform") == "tpu":
-                    record({"event": "watch_done", "ok": True})
-                    return 0
+            # run bench.py regardless of the pipeline rc: it re-probes and
+            # takes the TPU rung itself if the tunnel still answers, and a
+            # partial window (advisory check tripped, one sweep point lost,
+            # suite timed out) is exactly when captured evidence matters
+            # most — an all-or-nothing gate burned most of round 3's first
+            # window
+            bout, brc = _run_group(
+                [sys.executable, os.path.join(repo, "bench.py")],
+                dict(os.environ), 1800)
+            results = _json_lines(bout)
+            result = results[-1] if results else None
+            record({"event": "bench", "rc": brc, "result": result})
+            if (brc == 0 and result and result.get("platform") == "tpu"
+                    and rc == 0):
+                record({"event": "watch_done", "ok": True})
+                return 0
         time.sleep(interval_s)
     record({"event": "watch_done", "ok": False, "detail": "no window"})
     return 3

@@ -1,0 +1,108 @@
+"""Replay suite-config records captured in HW_WATCH.jsonl into BENCH_SUITE.json.
+
+The --watch pipeline streams every suite config record into its
+HW_WATCH.jsonl `full_run` entry as it is measured. If the suite process
+dies before its own (now incremental) BENCH_SUITE.json write — a tunnel
+death or timeout mid-window — those measurements are real but stranded in
+the watch log. This tool merges them back, tagging each with the watch
+record's timestamp so provenance stays visible:
+
+    python benchmarks/recover_watch_records.py            # merge all
+    python benchmarks/recover_watch_records.py --dry-run  # show only
+
+Only records that look like suite results (a `config` + `value` field, no
+`error`) are merged; newer-by-timestamp wins when the same config appears
+in several windows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from suite import _write_merged
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def captured_records(watch_path: str):
+    out, meta = [], None
+    with open(watch_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("event") != "full_run":
+                continue
+            ts = rec.get("ts")
+            for stage in rec.get("stages", []):
+                if "suite" in stage:  # the suite child's platform header
+                    meta = stage["suite"]
+                if ("config" in stage and "value" in stage
+                        and "error" not in stage):
+                    entry = dict(stage)
+                    # the pipeline measures configs shortly before the
+                    # full_run record is written, so the full_run ts is the
+                    # recency stamp used against existing records
+                    entry.setdefault("recorded_at", ts)
+                    entry["recovered_from"] = f"HW_WATCH.jsonl full_run {ts}"
+                    out.append(entry)
+    # last occurrence of a config (newest window) wins
+    newest = {}
+    for entry in out:
+        newest[entry["config"]] = entry
+    return list(newest.values()), meta
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--watch-log",
+                    default=os.path.join(HERE, "HW_WATCH.jsonl"))
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+
+    records, meta = captured_records(args.watch_log)
+    if not records:
+        print("no recoverable config records in", args.watch_log)
+        return 1
+    # recency guard: never let an old watch capture clobber a newer
+    # direct-run measurement (records carry recorded_at since round 3)
+    out_path = os.path.join(os.path.dirname(HERE), "BENCH_SUITE.json")
+    existing = {}
+    try:
+        with open(out_path) as f:
+            for r in json.load(f).get("results", []):
+                existing[r.get("config")] = r
+    except (OSError, ValueError):
+        pass
+    kept = []
+    for r in records:
+        prev = existing.get(r["config"])
+        prev_ts = (prev or {}).get("recorded_at")
+        if prev_ts and r.get("recorded_at") and prev_ts >= r["recorded_at"]:
+            print(f"skip {r['config']}: existing record ({prev_ts}) is newer")
+            continue
+        kept.append(r)
+    records = kept
+    if not records:
+        print("nothing to merge: all captures older than existing records")
+        return 0
+    for r in records:
+        print(f"{r['config']}: {r.get('value')} {r.get('unit', '')} "
+              f"[{r.get('platform', '?')}] <- {r['recovered_from']}")
+    if args.dry_run:
+        return 0
+    meta = dict(meta or {"platform": "unknown", "device_kind": "unknown"})
+    meta["note"] = "includes watch-captured records; see recovered_from"
+    _write_merged(out_path, records, meta)
+    print("merged", len(records), "records into", out_path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -592,6 +592,8 @@ def main():
         raise SystemExit(
             f"unknown SDA_BENCH_CONFIGS {unknown}; valid: {list(CONFIGS)}"
         )
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_SUITE.json")
     results = []
     for name in names:
         try:
@@ -600,12 +602,31 @@ def main():
             result = {"config": name.strip(),
                       "error": f"{type(e).__name__}: {e}"}
         result.setdefault("platform", dev.platform)
+        result["recorded_at"] = _utc_now()
         results.append(result)
         print(json.dumps(result), flush=True)
-    out_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "BENCH_SUITE.json")
-    # merge by config name so a partial SDA_BENCH_CONFIGS run refreshes
-    # only what it measured instead of clobbering the other records
+        # re-record after EVERY config: hardware windows die mid-suite
+        # (round 3 lost a 30-minute TPU run to an end-of-run-only write),
+        # so each completed config must land on disk immediately
+        _write_merged(out_path, results, meta)
+
+
+def _utc_now() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _write_merged(out_path, results, meta):
+    """Atomically merge ``results`` into BENCH_SUITE.json by config name.
+
+    Merging means a partial run (SDA_BENCH_CONFIGS subset, or a suite
+    killed mid-way by a tunnel death) refreshes only what it measured
+    instead of clobbering the other records. An error stub never replaces
+    an existing good measurement — a run dying config-by-config must not
+    erase the last healthy window's records.
+    """
     merged = {}
     try:
         with open(out_path) as f:
@@ -614,6 +635,9 @@ def main():
     except (OSError, ValueError):
         pass
     for r in results:
+        prev = merged.get(r.get("config"))
+        if ("error" in r and prev is not None and "error" not in prev):
+            continue
         merged[r.get("config")] = r
     ordered = [merged[n] for n in CONFIGS if n in merged]
     ordered += [r for c, r in merged.items() if c not in CONFIGS]
@@ -622,8 +646,10 @@ def main():
     platforms = sorted({r.get("platform") for r in ordered if r.get("platform")})
     header = dict(meta, last_run_platform=meta["platform"])
     header["platform"] = platforms[0] if len(platforms) == 1 else platforms
-    with open(out_path, "w") as f:
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"suite": header, "results": ordered}, f, indent=2)
+    os.replace(tmp, out_path)
 
 
 if __name__ == "__main__":
