@@ -84,7 +84,9 @@ def main() -> int:
     for r in records:
         prev = existing.get(r["config"])
         prev_ts = (prev or {}).get("recorded_at")
-        if prev_ts and r.get("recorded_at") and prev_ts >= r["recorded_at"]:
+        # an error stub never outranks a real capture, whatever its stamp
+        if (prev is not None and "error" not in prev and prev_ts
+                and r.get("recorded_at") and prev_ts >= r["recorded_at"]):
             print(f"skip {r['config']}: existing record ({prev_ts}) is newer")
             continue
         kept.append(r)

@@ -269,3 +269,35 @@ def test_pod_fedavg_training_improves():
         losses.append(global_loss(global_params))
 
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_streamed_fedavg_lora_adapters():
+    """pod_fedavg_round is polymorphic over the aggregation surfaces: the
+    same call drives StreamedPod (the HBM-exceeding large-model path, i.e.
+    the lora-13m setting) with LoRA adapter vectors, exactly."""
+    import jax
+
+    from sda_tpu.mesh import StreamedPod, make_mesh
+    from sda_tpu.protocol import AdditiveSharing
+
+    lora = LoRAMLP(features=32, layers=2, rank=4)
+    lp = lora.init(jax.random.PRNGKey(0), np.zeros((1, 16), np.float32))
+    adapters = lora_adapter_params(lp)
+    gvec, unravel = ravel_pytree(adapters)
+
+    pod = StreamedPod(AdditiveSharing(share_count=8, modulus=M31),
+                      mesh=make_mesh(4, 2), dim_chunk=256)
+    codec = FixedPointCodec(M31, fractional_bits=16, max_summands=3, clip=2.0)
+
+    rng = np.random.default_rng(5)
+    client_vecs = gvec[None, :] + rng.normal(0, 0.1, size=(3, gvec.size))
+    deltas = client_vecs - gvec[None, :]
+    expected = np.stack([codec.quantize(d) for d in deltas]).sum(0) \
+        / codec.scale / 3
+
+    new_vec = pod_fedavg_round(pod, codec, gvec, client_vecs,
+                               jax.random.PRNGKey(9))
+    # compare the updated vector itself: (g + m) - g re-rounds in float64
+    np.testing.assert_array_equal(new_vec, gvec + expected)
+    merged = merge_lora_params(lp, unravel(new_vec))
+    assert lora.apply(merged, np.zeros((2, 16), np.float32)).shape == (2, 10)
