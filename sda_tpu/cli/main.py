@@ -81,15 +81,76 @@ def build_parser() -> argparse.ArgumentParser:
                         help="packed secrets per polynomial (shamir)")
     lst = agg.add_parser("list")
     lst.add_argument("--filter", default=None)
-    for name in ("begin", "end", "status", "reveal", "delete", "show"):
+    for name in ("begin", "end", "status", "delete", "show"):
         p = agg.add_parser(name)
         p.add_argument("aggregation")
+    rev = agg.add_parser("reveal")
+    rev.add_argument("aggregation")
+    rev.add_argument("--fixed-point-bits", type=int, metavar="B",
+                     help="decode the revealed sum as fixed-point floats "
+                          "(scale 2^B); pairs with `participate --model`")
+    rev.add_argument("--mean", action="store_true",
+                     help="with --fixed-point-bits: print the mean update "
+                          "(sum / number of participations) instead of "
+                          "the sum")
 
     part = sub.add_parser("participate")
     part.add_argument("aggregation")
-    part.add_argument("values", nargs="+", type=int)
+    part.add_argument("values", nargs="*", type=int)
+    part.add_argument("--model", metavar="FILE",
+                      help="participate with a float vector from a .npy "
+                           "(or single-array .npz) file, fixed-point "
+                           "encoded to the aggregation's modulus")
+    part.add_argument("--fixed-point-bits", type=int, default=16, metavar="B",
+                      help="fractional bits for --model (default 16)")
+    part.add_argument("--clip", type=float,
+                      help="magnitude clip for --model (default: the "
+                           "capacity-derived bound)")
+    part.add_argument("--max-summands", type=int, default=1024,
+                      help="largest participant count the encoding must "
+                           "stay exact for (default 1024); bounds the "
+                           "clip range")
 
     return parser
+
+
+def _encode_model_values(client, agg_id, args):
+    """`participate --model FILE`: load a float vector, fixed-point encode
+    it to the aggregation's modulus. Returns int list, or None after
+    printing an error. The reveal side decodes with
+    `aggregations reveal --fixed-point-bits B [--mean]`."""
+    import numpy as np
+
+    from ..models import FixedPointCodec
+
+    try:
+        loaded = np.load(args.model)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot load {args.model}: {e}", file=sys.stderr)
+        return None
+    if hasattr(loaded, "files"):  # .npz archive: exactly one array
+        if len(loaded.files) != 1:
+            print(f"error: {args.model} holds {len(loaded.files)} arrays; "
+                  f"save a single flat vector", file=sys.stderr)
+            return None
+        loaded = loaded[loaded.files[0]]
+    vec = np.asarray(loaded, dtype=np.float64).reshape(-1)
+    aggregation = client.service.get_aggregation(client.agent, agg_id)
+    if aggregation is None:
+        print(f"error: no aggregation {agg_id}", file=sys.stderr)
+        return None
+    if vec.size != aggregation.vector_dimension:
+        print(f"error: {args.model} has {vec.size} elements; the "
+              f"aggregation wants {aggregation.vector_dimension}",
+              file=sys.stderr)
+        return None
+    try:
+        codec = FixedPointCodec(aggregation.modulus, args.fixed_point_bits,
+                                args.max_summands, clip=args.clip)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return None
+    return [int(v) for v in codec.encode(vec)]
 
 
 def load_client(args) -> SdaClient:
@@ -327,16 +388,59 @@ def main(argv=None) -> int:
             print(json.dumps(status.to_obj() if status else None, indent=2))
             return 0
         if args.agg_command == "reveal":
+            if args.mean and args.fixed_point_bits is None:
+                print("error: --mean needs --fixed-point-bits (a mean of "
+                      "raw field elements is not meaningful)",
+                      file=sys.stderr)
+                return 1
             output = client.reveal_aggregation(agg_id).positive()
-            print(" ".join(str(v) for v in output.values.tolist()))
+            if args.fixed_point_bits is None:
+                print(" ".join(str(v) for v in output.values.tolist()))
+                return 0
+            from ..models import FixedPointCodec
+
+            # divide by the revealed SNAPSHOT's summand count, not the
+            # aggregation-wide one: participations accepted after `end`
+            # (or in other pipelined snapshots) are not in this sum
+            n = output.participations
+            if n is None:  # foreign service without a snapshot count
+                status = client.service.get_aggregation_status(
+                    client.agent, agg_id)
+                n = status.number_of_participations
+            try:
+                codec = FixedPointCodec(output.modulus,
+                                        args.fixed_point_bits, n)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            decoded = (codec.decode_mean(output.values, n) if args.mean
+                       else codec.decode_sum(output.values, n))
+            print(" ".join(repr(float(v)) for v in decoded))
             return 0
         if args.agg_command == "delete":
             client.service.delete_aggregation(client.agent, agg_id)
             return 0
 
     if args.command == "participate":
+        agg_id = AggregationId(args.aggregation)
+        if args.model and args.values:
+            print("error: give either integer values or --model, not both",
+                  file=sys.stderr)
+            return 1
+        # register the agent BEFORE any service read: a fresh identity's
+        # auth token is only minted server-side on its first upload
         client.upload_agent()
-        client.participate(args.values, AggregationId(args.aggregation))
+        if args.model:
+            values = _encode_model_values(client, agg_id, args)
+            if values is None:
+                return 1
+        elif args.values:
+            values = args.values
+        else:
+            print("error: nothing to participate with (integer values "
+                  "or --model FILE)", file=sys.stderr)
+            return 1
+        client.participate(values, agg_id)
         return 0
 
     return 1

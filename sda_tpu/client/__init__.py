@@ -35,21 +35,33 @@ from ..protocol import (
 
 
 class RecipientOutput:
-    """Revealed aggregate (receive.rs:7-21)."""
+    """Revealed aggregate (receive.rs:7-21).
 
-    __slots__ = ("modulus", "values")
+    ``participations`` is the number of summands in THIS revealed
+    snapshot (SnapshotResult.number_of_participations) — not the
+    aggregation-wide count, which can be larger when participations
+    arrive after the snapshot froze the set or when rounds are
+    pipelined. Fixed-point mean decoding must divide by this.
+    """
 
-    def __init__(self, modulus: int, values):
+    __slots__ = ("modulus", "values", "participations")
+
+    def __init__(self, modulus: int, values, participations=None):
         self.modulus = modulus
         self.values = np.asarray(values, dtype=np.int64)
+        self.participations = (None if participations is None
+                               else int(participations))
 
     def positive(self) -> "RecipientOutput":
         """Lift representatives into [0, modulus) — kept for API parity;
         this implementation is canonical already (receive.rs:14-21)."""
-        return RecipientOutput(self.modulus, np.mod(self.values, self.modulus))
+        return RecipientOutput(self.modulus, np.mod(self.values, self.modulus),
+                               self.participations)
 
     def __repr__(self):
-        return f"RecipientOutput(modulus={self.modulus}, values={self.values!r})"
+        return (f"RecipientOutput(modulus={self.modulus}, "
+                f"values={self.values!r}, "
+                f"participations={self.participations})")
 
 
 class SdaClient:
@@ -357,4 +369,5 @@ class SdaClient:
         unmasker = self.crypto.new_secret_unmasker(aggregation.masking_scheme)
         with timed_phase("recipient.unmask"):
             output = unmasker.unmask(mask, masked_output)
-        return RecipientOutput(modulus=aggregation.modulus, values=output)
+        return RecipientOutput(modulus=aggregation.modulus, values=output,
+                               participations=result.number_of_participations)

@@ -209,3 +209,80 @@ def test_sim_cli_multihost(tmp_path, capsys):
         "--multihost", "3",
     ])
     assert rc == 1
+
+
+def test_cli_model_participation_fixed_point(httpd, tmp_path, capsys):
+    """`participate --model file.npy` + `reveal --fixed-point-bits --mean`:
+    the secure mean of float model vectors through the real CLI equals the
+    plaintext quantized oracle exactly."""
+    import numpy as np
+
+    from sda_tpu.models import FixedPointCodec
+
+    url = httpd.address
+    m31 = (1 << 31) - 1
+
+    def sda(identity, *args):
+        rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity),
+                       *args])
+        assert rc == 0
+        return capsys.readouterr().out.strip()
+
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "agent", "create")
+        sda(who, "agent", "keys", "create")
+    agg_id = sda(
+        "recipient", "aggregations", "create", "fedavg",
+        "--dimension", "6", "--modulus", str(m31), "--shares", "3",
+    )
+    sda("recipient", "aggregations", "begin", agg_id)
+
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(0, 1, size=(2, 6))
+    for i, vec in enumerate(vecs):
+        path = tmp_path / f"update{i}.npy"
+        np.save(path, vec)
+        # NO prior `agent create`: --model as a fresh identity's first
+        # command must self-register before its service reads
+        sda(f"part-{i}", "participate", agg_id, "--model", str(path),
+            "--clip", "4.0")
+
+    sda("recipient", "aggregations", "end", agg_id)
+
+    # a straggler arriving AFTER the snapshot froze the set: counted by
+    # the aggregation status but not in the revealed sum — the decoded
+    # mean must divide by the snapshot's 2, not the status's 3
+    late = tmp_path / "late.npy"
+    np.save(late, rng.normal(0, 1, size=6))
+    sda("part-late", "participate", agg_id, "--model", str(late),
+        "--clip", "4.0")
+
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "clerk", "--once")
+
+    out = sda("recipient", "aggregations", "reveal", agg_id,
+              "--fixed-point-bits", "16", "--mean")
+    got = np.array([float(v) for v in out.split()])
+    codec = FixedPointCodec(m31, 16, 1024, clip=4.0)
+    oracle = np.stack([codec.quantize(v) for v in vecs]).sum(0) \
+        / codec.scale / 2
+    np.testing.assert_array_equal(got, oracle)
+
+    # --mean without --fixed-point-bits is a usage error, not raw ints
+    rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / "recipient"),
+                   "aggregations", "reveal", agg_id, "--mean"])
+    assert rc == 1
+    assert "--fixed-point-bits" in capsys.readouterr().err
+
+    # guard rails: both values and --model, and a wrong-dimension model
+    rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / "part-0"),
+                   "participate", agg_id, "1", "2",
+                   "--model", str(tmp_path / "update0.npy")])
+    assert rc == 1
+    assert "not both" in capsys.readouterr().err
+    bad = tmp_path / "bad.npy"
+    np.save(bad, np.zeros(5))
+    rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / "part-0"),
+                   "participate", agg_id, "--model", str(bad)])
+    assert rc == 1
+    assert "6" in capsys.readouterr().err
