@@ -117,10 +117,36 @@ def _run(platform: str, use_pallas: bool) -> dict:
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
         "pallas": use_pallas,
+        "execution": "monolithic",
         "round_seconds_marginal": round(per_round, 5),
         "compile_seconds": round(compile_s, 1),
         **timing,
     }
+
+    # -- streamed execution of the SAME round ----------------------------
+    # The dim-chunked scan has better locality than the full-width round
+    # (round-3 window: pallas streamed step 8.76e9 vs 5.76e9 monolithic),
+    # so the framework's fast path for this workload is the streaming
+    # driver. Exactness is checked on the REAL driver end-to-end; the
+    # round time is composed from RTT-cancelled marginals of its two
+    # device phases (accumulate steps + finale), same methodology as
+    # everything else through the tunnel. Faster execution wins the
+    # headline; both are recorded.
+    if os.environ.get("SDA_BENCH_STREAMED", "1" if on_tpu else "0") == "1":
+        try:
+            s_res = _run_streamed(scheme, p, inputs, expected, key,
+                                  use_pallas, target)
+            result["streamed"] = s_res
+            if s_res["value"] > result["value"]:
+                result.update(
+                    value=s_res["value"],
+                    vs_baseline=round(s_res["value"] / _NORTH_STAR, 4),
+                    execution="streamed",
+                    round_seconds_marginal=s_res["round_seconds"],
+                )
+        except Exception as e:  # never lose the monolithic measurement
+            result["streamed"] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}"}
     if not on_tpu:
         # CPU fallback (tunnel down): point at the committed real-chip
         # record so the fallback number is not mistaken for chip perf
@@ -157,6 +183,108 @@ def _recorded_tpu_result():
     except Exception:
         pass
     return None
+
+
+def _run_streamed(scheme, p, inputs, expected, key, use_pallas,
+                  target_seconds) -> dict:
+    """Complete streamed round on device-resident input, composed timing.
+
+    One dim tile (dim_chunk=dim), ceil(P/pc) accumulate steps, one finale.
+    Exactness runs the real StreamingAggregator driver over device slices
+    of the same inputs; timing chains step dispatches (accumulators
+    carried, two alternating resident blocks) and finale dispatches
+    (fresh accumulator copies per call — the copy makes the finale number
+    conservative), both via the marginal method.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sda_tpu.mesh import StreamingAggregator
+    from sda_tpu.protocol import FullMasking
+    from sda_tpu.utils.benchtime import marginal_seconds
+
+    participants, dim = inputs.shape
+    pc = int(os.environ.get("SDA_BENCH_STREAM_PC", 64))
+    agg = StreamingAggregator(
+        scheme, FullMasking(p), participants_chunk=pc, dim_chunk=dim,
+        use_pallas=use_pallas,
+    )
+
+    # exactness: the real driver, blocks sliced on device (no host hop)
+    s_out = agg.aggregate_blocks(
+        lambda p0, p1, d0, d1: inputs[p0:p1, d0:d1], participants, dim, key)
+    assert np.array_equal(s_out, expected), \
+        "streamed round produced wrong aggregate"
+
+    # mirror the driver's tiling exactly (_drive_stream): one dim tile
+    # padded to the scheme grain; the ragged last participant block has
+    # its own compiled shape. Each distinct shape is timed with its OWN
+    # homogeneous dispatch chain (mixing shapes in one chain would bias
+    # the differenced mean whenever the window is not a multiple of the
+    # shape count), then the round time is composed by multiplicity. One
+    # resident block per shape; the step/finale programs come from the
+    # caches the exactness run above already compiled (agg._steps/_finals).
+    d_size = -(-dim // agg._grain) * agg._grain
+    acc_dtype = agg._field.dtype
+    B = d_size // scheme.input_size
+    n_full, ragged = divmod(participants, pc)
+    shapes = ([(pc, n_full)] if n_full else []) + \
+        ([(ragged, 1)] if ragged else [])
+    state = {
+        "a": [jnp.zeros((scheme.output_size, B), acc_dtype),
+              jnp.zeros((d_size,), acc_dtype)],
+        "i": 0,
+    }
+    steps_total_s = 0.0
+    step_info = {}
+    for rows, multiplicity in shapes:
+        blk = inputs[:rows]
+        if d_size != dim:  # zero columns aggregate as zero, as driven
+            blk = jnp.pad(blk, ((0, 0), (0, d_size - dim)))
+        step = agg._steps.get(blk.shape)
+        if step is None:
+            step = agg._steps[blk.shape] = agg._step_fn(blk.shape)
+
+        def disp(_):
+            state["a"] = list(step(
+                blk, jax.random.fold_in(key, state["i"]), key,
+                jnp.int32(0), jnp.int32(0), *state["a"],
+            ))
+            state["i"] += 1
+            return state["a"][0]
+
+        jax.device_get(jnp.ravel(disp(0))[0])  # warm (cached compile)
+        per_step, step_info = marginal_seconds(
+            disp, target_seconds=target_seconds / len(shapes))
+        steps_total_s += multiplicity * per_step
+
+    final = agg._finals.get(d_size)
+    if final is None:
+        final = agg._finals[d_size] = agg._final_fn(d_size)
+    master_s, master_m = state["a"]
+
+    def disp_final(_):
+        # device-side copies: final() donates its inputs, and the masters
+        # must survive repeated dispatches (no host round-trip)
+        return final(jnp.copy(master_s), jnp.copy(master_m))
+
+    jax.device_get(jnp.ravel(disp_final(0))[0])  # warm (cached compile)
+    per_final, final_info = marginal_seconds(
+        disp_final, target_seconds=max(2.0, target_seconds / 2))
+
+    round_s = steps_total_s + per_final
+    return {
+        "value": round(participants * dim / round_s),
+        "round_seconds": round(round_s, 5),
+        "participants_chunk": pc,
+        "steps": n_full + (1 if ragged else 0),
+        "steps_seconds_marginal": round(steps_total_s, 5),
+        "finale_seconds_marginal": round(per_final, 5),
+        "timing": "composed: per-shape step chains + finale, each "
+                  "chained-dispatch marginal",
+        "exact": True,
+    }
 
 
 def _child_main(rung: str) -> None:

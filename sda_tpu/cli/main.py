@@ -125,16 +125,16 @@ def _encode_model_values(client, agg_id, args):
 
     try:
         loaded = np.load(args.model)
+        if hasattr(loaded, "files"):  # .npz archive: exactly one array
+            if len(loaded.files) != 1:
+                print(f"error: {args.model} holds {len(loaded.files)} "
+                      f"arrays; save a single flat vector", file=sys.stderr)
+                return None
+            loaded = loaded[loaded.files[0]]
+        vec = np.asarray(loaded, dtype=np.float64).reshape(-1)
     except (OSError, ValueError) as e:
         print(f"error: cannot load {args.model}: {e}", file=sys.stderr)
         return None
-    if hasattr(loaded, "files"):  # .npz archive: exactly one array
-        if len(loaded.files) != 1:
-            print(f"error: {args.model} holds {len(loaded.files)} arrays; "
-                  f"save a single flat vector", file=sys.stderr)
-            return None
-        loaded = loaded[loaded.files[0]]
-    vec = np.asarray(loaded, dtype=np.float64).reshape(-1)
     aggregation = client.service.get_aggregation(client.agent, agg_id)
     if aggregation is None:
         print(f"error: no aggregation {agg_id}", file=sys.stderr)
@@ -403,10 +403,15 @@ def main(argv=None) -> int:
             # aggregation-wide one: participations accepted after `end`
             # (or in other pipelined snapshots) are not in this sum
             n = output.participations
-            if n is None:  # foreign service without a snapshot count
-                status = client.service.get_aggregation_status(
-                    client.agent, agg_id)
-                n = status.number_of_participations
+            if n is None:
+                # only a RecipientOutput constructed outside
+                # reveal_aggregation can lack the count; the aggregation-
+                # wide status count would be the WRONG divisor (stragglers
+                # after `end` are counted there but not summed), so refuse
+                print("error: revealed output carries no snapshot "
+                      "participation count; cannot decode a mean/sum "
+                      "safely", file=sys.stderr)
+                return 1
             try:
                 codec = FixedPointCodec(output.modulus,
                                         args.fixed_point_bits, n)
