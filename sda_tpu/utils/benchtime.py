@@ -73,13 +73,33 @@ def marginal_seconds(
 
 
 def pallas_knobs():
-    """(p_block, tile) kernel-tuning knobs from the environment —
-    SDA_PALLAS_PBLOCK (default 16) and SDA_PALLAS_TILE (default None =
-    auto), shared by bench.py, benchmarks/suite.py and the sweep harness."""
+    """(p_block, tile) kernel-tuning knobs, shared by bench.py,
+    benchmarks/suite.py and the sweep harness.
+
+    Priority: SDA_PALLAS_PBLOCK / SDA_PALLAS_TILE env vars, then the
+    committed hardware-sweep record benchmarks/PALLAS_KNOBS.json (written
+    by hw_check's on-chip sweep so fresh processes — the driver's bench
+    run in particular — inherit the tuned values), then (16, None=auto).
+    """
+    import json
     import os
 
+    pb_env = os.environ.get("SDA_PALLAS_PBLOCK")
     tile_env = os.environ.get("SDA_PALLAS_TILE")
-    return (
-        int(os.environ.get("SDA_PALLAS_PBLOCK", 16)),
-        int(tile_env) if tile_env else None,
-    )
+    pb = int(pb_env) if pb_env else None
+    tile = int(tile_env) if tile_env else None
+    if pb is None or tile is None:
+        try:
+            path = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))),
+                "benchmarks", "PALLAS_KNOBS.json")
+            with open(path) as f:
+                rec = json.load(f)
+            if pb is None and isinstance(rec.get("p_block"), int):
+                pb = rec["p_block"]
+            if tile is None and isinstance(rec.get("tile"), int):
+                tile = rec["tile"]
+        except (OSError, ValueError):
+            pass
+    return (pb if pb is not None else 16, tile)

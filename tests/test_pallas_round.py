@@ -135,3 +135,19 @@ def test_pallas_round_rejects_generic_prime():
     s = PackedShamirSharing(3, 8, 4, 433, 354, 150)
     with pytest.raises(ValueError, match="Solinas"):
         single_chip_round_pallas(s)
+
+
+@pytest.mark.parametrize("p_block", [50, 100])
+def test_pallas_round_divisor_p_blocks(p_block):
+    """p_block values dividing P exactly (the sweep's zero-padding points:
+    at P=100, p_block 16/32/64 pad the participant axis to 112/128 rows
+    while 50/100 pad none) stay exact."""
+    s = fast_scheme()
+    fn = single_chip_round_pallas(
+        s, FullMasking(s.prime_modulus), p_block=p_block, tile=128,
+        interpret=True, external_bits_fn=external_bits,
+    )
+    rng = np.random.default_rng(3)
+    inputs = rng.integers(0, 1 << 20, size=(100, 3 * 128))
+    out = np.asarray(fn(jnp.asarray(inputs), jax.random.PRNGKey(5)))
+    np.testing.assert_array_equal(out, inputs.sum(axis=0) % s.prime_modulus)
