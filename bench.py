@@ -205,7 +205,19 @@ def _run_streamed(scheme, p, inputs, expected, key, use_pallas,
     from sda_tpu.utils.benchtime import marginal_seconds
 
     participants, dim = inputs.shape
-    pc = int(os.environ.get("SDA_BENCH_STREAM_PC", 64))
+    pc_env = os.environ.get("SDA_BENCH_STREAM_PC")
+    if pc_env:
+        pc = int(pc_env)
+    else:  # hardware-sweep record, if any (hw_check streamed A/B)
+        pc = 64
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "benchmarks", "PALLAS_KNOBS.json")) as f:
+                rec = json.load(f)
+            if isinstance(rec.get("stream_pc"), int):
+                pc = rec["stream_pc"]
+        except (OSError, ValueError):
+            pass
     agg = StreamingAggregator(
         scheme, FullMasking(p), participants_chunk=pc, dim_chunk=dim,
         use_pallas=use_pallas,

@@ -277,6 +277,7 @@ def main() -> int:
             # whether the kernel's win carries into the streamed mode
             os.environ["SDA_PALLAS_PBLOCK"] = str(best["p_block"])
             os.environ["SDA_PALLAS_TILE"] = str(best["tile"])
+            best_stream = {}
             try:
                 from sda_tpu.mesh import (
                     StreamingAggregator,
@@ -284,19 +285,24 @@ def main() -> int:
                     synthetic_device_block_provider32,
                 )
 
-                dc, pc = 3 * (1 << 19), 64
+                dc = 3 * (1 << 19)
                 prov = synthetic_block_provider32(p, seed=3, max_value=1 << 20)
                 # timing blocks generated ON DEVICE (bit-identical twin
                 # generator): ~1.6 GB of H2D through the flaky tunnel could
                 # burn the window before the suite re-record runs
                 prov_dev = synthetic_device_block_provider32(
                     p, seed=3, max_value=1 << 20)
-                blocks = [jnp.asarray(prov_dev(i * pc, (i + 1) * pc, 0, dc))
-                          for i in range(4)]
-                jax.block_until_ready(blocks)
-                expected_ab = (prov(0, pc, 0, 4096).astype(np.int64)
-                               .sum(axis=0) % p)
-                for use_p in (False, True):
+                # pc variants (pallas only for the extras): 50/100 divide
+                # the flagship's P=100 into unpadded blocks — evidence for
+                # bench.py's SDA_BENCH_STREAM_PC default
+                for use_p, pc in ((False, 64), (True, 64), (True, 50),
+                                  (True, 100)):
+                    blocks = [jnp.asarray(
+                        prov_dev(i * pc, (i + 1) * pc, 0, dc))
+                        for i in range(2)]
+                    jax.block_until_ready(blocks)
+                    expected_ab = (prov(0, pc, 0, 4096).astype(np.int64)
+                                   .sum(axis=0) % p)
                     agg = StreamingAggregator(
                         scheme, FullMasking(p), participants_chunk=pc,
                         dim_chunk=dc, use_pallas=use_p,
@@ -311,7 +317,7 @@ def main() -> int:
 
                     def disp(_):
                         state["a"] = list(step(
-                            blocks[state["i"] % 4],
+                            blocks[state["i"] % 2],
                             jax.random.fold_in(key, state["i"]), key,
                             jnp.int32(state["i"] * pc), jnp.int32(0),
                             *state["a"],
@@ -321,10 +327,24 @@ def main() -> int:
 
                     jax.device_get(jnp.ravel(disp(0))[0])  # warm/compile
                     per, _i2 = marginal_seconds(disp, target_seconds=5)
-                    _emit("streamed_ab", pallas=use_p, ok=ab_exact,
-                          chunk_ms=round(per * 1000, 2),
-                          gel_per_sec=round(pc * dc / per / 1e9, 2))
+                    rate = round(pc * dc / per / 1e9, 2)
+                    _emit("streamed_ab", pallas=use_p, pc=pc, ok=ab_exact,
+                          chunk_ms=round(per * 1000, 2), gel_per_sec=rate)
                     ok = ok and ab_exact
+                    if use_p and ab_exact and rate > best_stream.get("rate", 0):
+                        best_stream.update(pc=pc, rate=rate)
+                    del blocks, accs, state
+                if best_stream:
+                    # record the best streamed chunking next to the kernel
+                    # knobs; bench.py's streamed rung reads it as its pc
+                    # default
+                    with open(knobs_path) as kf:
+                        rec = json.load(kf)
+                    rec["stream_pc"] = best_stream["pc"]
+                    rec["stream_gel_per_sec"] = best_stream["rate"]
+                    with open(tmp_path, "w") as kf:
+                        json.dump(rec, kf, indent=2)
+                    os.replace(tmp_path, knobs_path)
             except Exception as e:
                 _emit("streamed_ab", ok=False,
                       error=f"{type(e).__name__}: {str(e)[:300]}")
