@@ -204,20 +204,10 @@ def _run_streamed(scheme, p, inputs, expected, key, use_pallas,
     from sda_tpu.protocol import FullMasking
     from sda_tpu.utils.benchtime import marginal_seconds
 
+    from sda_tpu.utils.benchtime import stream_pc_knob
+
     participants, dim = inputs.shape
-    pc_env = os.environ.get("SDA_BENCH_STREAM_PC")
-    if pc_env:
-        pc = int(pc_env)
-    else:  # hardware-sweep record, if any (hw_check streamed A/B)
-        pc = 64
-        try:
-            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   "benchmarks", "PALLAS_KNOBS.json")) as f:
-                rec = json.load(f)
-            if isinstance(rec.get("stream_pc"), int):
-                pc = rec["stream_pc"]
-        except (OSError, ValueError):
-            pass
+    pc = stream_pc_knob()
     agg = StreamingAggregator(
         scheme, FullMasking(p), participants_chunk=pc, dim_chunk=dim,
         use_pallas=use_pallas,

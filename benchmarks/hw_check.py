@@ -333,18 +333,17 @@ def main() -> int:
                     ok = ok and ab_exact
                     if use_p and ab_exact and rate > best_stream.get("rate", 0):
                         best_stream.update(pc=pc, rate=rate)
+                        # persist IMMEDIATELY (not after the loop): a later
+                        # pc variant OOMing or the tunnel dropping must not
+                        # discard an already-measured winner
+                        with open(knobs_path) as kf:
+                            rec = json.load(kf)
+                        rec["stream_pc"] = best_stream["pc"]
+                        rec["stream_gel_per_sec"] = best_stream["rate"]
+                        with open(tmp_path, "w") as kf:
+                            json.dump(rec, kf, indent=2)
+                        os.replace(tmp_path, knobs_path)
                     del blocks, accs, state
-                if best_stream:
-                    # record the best streamed chunking next to the kernel
-                    # knobs; bench.py's streamed rung reads it as its pc
-                    # default
-                    with open(knobs_path) as kf:
-                        rec = json.load(kf)
-                    rec["stream_pc"] = best_stream["pc"]
-                    rec["stream_gel_per_sec"] = best_stream["rate"]
-                    with open(tmp_path, "w") as kf:
-                        json.dump(rec, kf, indent=2)
-                    os.replace(tmp_path, knobs_path)
             except Exception as e:
                 _emit("streamed_ab", ok=False,
                       error=f"{type(e).__name__}: {str(e)[:300]}")

@@ -17,6 +17,7 @@ from ..client import SdaClient
 from ..protocol import (
     AdditiveSharing,
     Agent,
+    AgentId,
     Aggregation,
     AggregationId,
     BasicShamirSharing,
@@ -24,6 +25,7 @@ from ..protocol import (
     EncryptionKeyId,
     FullMasking,
     NoMasking,
+    NotFound,
     PackedPaillierEncryption,
     PackedShamirSharing,
     SodiumEncryption,
@@ -47,6 +49,16 @@ def build_parser() -> argparse.ArgumentParser:
     agent = sub.add_parser("agent").add_subparsers(dest="agent_command", required=True)
     agent.add_parser("create")
     agent.add_parser("show")
+    prof = agent.add_parser("profile").add_subparsers(
+        dest="profile_command", required=True)
+    prof_set = prof.add_parser("set")
+    prof_set.add_argument("--name")
+    prof_set.add_argument("--twitter", dest="twitter_id")
+    prof_set.add_argument("--keybase", dest="keybase_id")
+    prof_set.add_argument("--website")
+    prof_show = prof.add_parser("show")
+    prof_show.add_argument("agent_id", nargs="?",
+                           help="default: this identity's own profile")
     keys = agent.add_parser("keys").add_subparsers(dest="keys_command", required=True)
     keys_create = keys.add_parser("create")
     keys_create.add_argument("--encryption", choices=["sodium", "paillier"],
@@ -81,9 +93,16 @@ def build_parser() -> argparse.ArgumentParser:
                         help="packed secrets per polynomial (shamir)")
     lst = agg.add_parser("list")
     lst.add_argument("--filter", default=None)
-    for name in ("begin", "end", "status", "delete", "show"):
+    for name in ("end", "status", "delete", "show"):
         p = agg.add_parser(name)
         p.add_argument("aggregation")
+    begin = agg.add_parser("begin")
+    begin.add_argument("aggregation")
+    begin.add_argument("--clerk", action="append", dest="clerks",
+                       metavar="AGENT_ID",
+                       help="choose this agent for the committee (repeat "
+                            "once per clerk, in committee order); default: "
+                            "elect automatically from suggestions")
     rev = agg.add_parser("reveal")
     rev.add_argument("aggregation")
     rev.add_argument("--fixed-point-bits", type=int, metavar="B",
@@ -216,6 +235,22 @@ def main(argv=None) -> int:
             return 0
         if args.agent_command == "show":
             print(json.dumps(client.agent.to_obj(), indent=2))
+            return 0
+        if args.agent_command == "profile":
+            from ..protocol import Profile
+
+            if args.profile_command == "set":
+                client.upload_agent()
+                client.upsert_profile(Profile(
+                    owner=client.agent.id, name=args.name,
+                    twitter_id=args.twitter_id, keybase_id=args.keybase_id,
+                    website=args.website,
+                ))
+                return 0
+            owner = (AgentId(args.agent_id) if args.agent_id
+                     else client.agent.id)
+            profile = client.get_profile(owner)
+            print(json.dumps(profile.to_obj() if profile else None, indent=2))
             return 0
         if args.agent_command == "keys":
             client.upload_agent()  # idempotent; key upload needs the agent
@@ -378,7 +413,15 @@ def main(argv=None) -> int:
             return 0
         agg_id = AggregationId(args.aggregation)
         if args.agg_command == "begin":
-            client.begin_aggregation(agg_id)
+            if args.clerks:
+                try:
+                    client.begin_aggregation_with(
+                        agg_id, [AgentId(c) for c in args.clerks])
+                except (NotFound, ValueError) as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 1
+            else:
+                client.begin_aggregation(agg_id)
             return 0
         if args.agg_command == "end":
             client.end_aggregation(agg_id)

@@ -28,6 +28,7 @@ from ..protocol import (
     PackedPaillierEncryption,
     Participation,
     ParticipationId,
+    Profile,
     SdaService,
     Snapshot,
     SnapshotId,
@@ -64,6 +65,17 @@ class RecipientOutput:
                 f"participations={self.participations})")
 
 
+def _committee_key_variant(aggregation: Aggregation) -> str:
+    """The key variant clerks must hold for this aggregation's committee
+    encryption scheme."""
+    return (
+        "PackedPaillier"
+        if isinstance(aggregation.committee_encryption_scheme,
+                      PackedPaillierEncryption)
+        else "Sodium"
+    )
+
+
 class SdaClient:
     def __init__(self, agent: Agent, keystore: Keystore, service: SdaService):
         self.agent = agent
@@ -93,6 +105,19 @@ class SdaClient:
         if signed is None:
             raise NotFound("could not sign encryption key")
         self.service.create_encryption_key(self.agent, signed)
+
+    def upsert_profile(self, profile: Profile) -> None:
+        """Publish this agent's trust-building profile — the reference's
+        'link their profile to some external authenticating system'
+        (README.md 'Doing more'; resource: resources.rs:24-35). The service
+        enforces owner == caller; this is the client-side convenience the
+        reference's Maintenance trait never grew."""
+        if profile.owner != self.agent.id:
+            raise ValueError("profile.owner must be this client's agent id")
+        self.service.upsert_profile(self.agent, profile)
+
+    def get_profile(self, owner: AgentId) -> Optional[Profile]:
+        return self.service.get_profile(self.agent, owner)
 
     # ------------------------------------------------------------------
     # Participating (participate.rs)
@@ -176,6 +201,20 @@ class SdaClient:
             raise ValueError("signature verification failed for key")
         return signed_key.body.body
 
+    def _first_verified_key(self, owner_id: AgentId, key_ids,
+                            want: str) -> Optional[EncryptionKeyId]:
+        """First of ``key_ids`` that verifies and matches the ``want``
+        variant — the single key-acceptance rule for BOTH automatic
+        election and recipient-chosen committees."""
+        for key_id in key_ids:
+            try:
+                key = self._fetch_verified_key(owner_id, key_id)
+            except (NotFound, ValueError):
+                continue
+            if key.variant == want:
+                return key_id
+        return None
+
     # ------------------------------------------------------------------
     # Clerking (clerk.rs)
 
@@ -255,12 +294,7 @@ class SdaClient:
             raise NotFound(f"unknown aggregation {aggregation_id}")
         candidates = self.service.suggest_committee(self.agent, aggregation_id)
         needed = aggregation.committee_sharing_scheme.output_size
-        want = (
-            "PackedPaillier"
-            if isinstance(aggregation.committee_encryption_scheme,
-                          PackedPaillierEncryption)
-            else "Sodium"
-        )
+        want = _committee_key_variant(aggregation)
         # filtered CLIENT-side on purpose: committee election is the
         # recipient's judgment call in the reference protocol
         # (receive.rs:48-62), and the recipient should not trust the broker
@@ -272,19 +306,60 @@ class SdaClient:
         for c in candidates:
             if len(selected) == needed:
                 break
-            for key_id in c.keys:
-                try:
-                    key = self._fetch_verified_key(c.id, key_id)
-                except (NotFound, ValueError):
-                    continue
-                if key.variant == want:
-                    selected.append((c.id, key_id))
-                    break
+            key_id = self._first_verified_key(c.id, c.keys, want)
+            if key_id is not None:
+                selected.append((c.id, key_id))
         if len(selected) < needed:
             raise NotFound(
                 f"only {len(selected)} of {needed} committee candidates "
                 f"have a verified {want} encryption key"
             )
+        self.service.create_committee(
+            self.agent, Committee(aggregation=aggregation_id, clerks_and_keys=selected)
+        )
+
+    def begin_aggregation_with(
+        self, aggregation_id: AggregationId, clerks: Sequence[AgentId]
+    ) -> None:
+        """Recipient-CHOSEN committee — the reference's 'allow recipient to
+        actually chose the clerks that should get in the committee'
+        (README.md 'Doing more', never implemented there).
+
+        ``clerks`` must name exactly ``output_size`` candidates from the
+        service's suggestion list, in the committee order the recipient
+        wants (order fixes each clerk's share index). Every chosen clerk
+        goes through the same key verification election uses — an
+        unverifiable or wrong-variant key fails here, not at participate
+        time.
+        """
+        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        if aggregation is None:
+            raise NotFound(f"unknown aggregation {aggregation_id}")
+        needed = aggregation.committee_sharing_scheme.output_size
+        if len(clerks) != needed:
+            raise ValueError(
+                f"chose {len(clerks)} clerks; the sharing scheme needs "
+                f"exactly {needed}")
+        if len(set(clerks)) != len(clerks):
+            raise ValueError("chosen clerks must be distinct")
+        candidates = {
+            c.id: c
+            for c in self.service.suggest_committee(self.agent, aggregation_id)
+        }
+        want = _committee_key_variant(aggregation)
+        selected = []
+        for clerk_id in clerks:
+            candidate = candidates.get(clerk_id)
+            if candidate is None:
+                raise NotFound(
+                    f"chosen clerk {clerk_id} is not a committee candidate "
+                    f"(no registered encryption key)")
+            chosen_key = self._first_verified_key(clerk_id, candidate.keys, want)
+            if chosen_key is None:
+                raise NotFound(
+                    f"chosen clerk {clerk_id} has no verified {want} "
+                    f"encryption key")
+            selected.append((clerk_id, chosen_key))
         self.service.create_committee(
             self.agent, Committee(aggregation=aggregation_id, clerks_and_keys=selected)
         )

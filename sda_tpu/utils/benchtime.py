@@ -72,16 +72,34 @@ def marginal_seconds(
     return per, info
 
 
+def _knobs_record() -> dict:
+    """The committed hardware-sweep record benchmarks/PALLAS_KNOBS.json
+    (written by hw_check's on-chip sweep), or {} when absent/unreadable.
+    Resolved relative to this package's repo checkout."""
+    import json
+    import os
+
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "benchmarks", "PALLAS_KNOBS.json")
+        with open(path) as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
 def pallas_knobs():
     """(p_block, tile) kernel-tuning knobs, shared by bench.py,
     benchmarks/suite.py and the sweep harness.
 
     Priority: SDA_PALLAS_PBLOCK / SDA_PALLAS_TILE env vars, then the
-    committed hardware-sweep record benchmarks/PALLAS_KNOBS.json (written
-    by hw_check's on-chip sweep so fresh processes — the driver's bench
-    run in particular — inherit the tuned values), then (16, None=auto).
+    hardware-sweep record (see _knobs_record — so fresh processes, the
+    driver's bench run in particular, inherit the tuned values), then
+    (16, None=auto).
     """
-    import json
     import os
 
     pb_env = os.environ.get("SDA_PALLAS_PBLOCK")
@@ -89,17 +107,22 @@ def pallas_knobs():
     pb = int(pb_env) if pb_env else None
     tile = int(tile_env) if tile_env else None
     if pb is None or tile is None:
-        try:
-            path = os.path.join(
-                os.path.dirname(os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__)))),
-                "benchmarks", "PALLAS_KNOBS.json")
-            with open(path) as f:
-                rec = json.load(f)
-            if pb is None and isinstance(rec.get("p_block"), int):
-                pb = rec["p_block"]
-            if tile is None and isinstance(rec.get("tile"), int):
-                tile = rec["tile"]
-        except (OSError, ValueError):
-            pass
+        rec = _knobs_record()
+        if pb is None and isinstance(rec.get("p_block"), int):
+            pb = rec["p_block"]
+        if tile is None and isinstance(rec.get("tile"), int):
+            tile = rec["tile"]
     return (pb if pb is not None else 16, tile)
+
+
+def stream_pc_knob(default: int = 64) -> int:
+    """Streamed participant-chunk size: SDA_BENCH_STREAM_PC env, then the
+    hardware A/B record's stream_pc, then ``default``."""
+    import os
+
+    env = os.environ.get("SDA_BENCH_STREAM_PC")
+    if env:
+        return int(env)
+    rec = _knobs_record()
+    return rec["stream_pc"] if isinstance(rec.get("stream_pc"), int) \
+        else default

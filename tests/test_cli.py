@@ -286,3 +286,81 @@ def test_cli_model_participation_fixed_point(httpd, tmp_path, capsys):
                    "participate", agg_id, "--model", str(bad)])
     assert rc == 1
     assert "6" in capsys.readouterr().err
+
+
+def test_cli_profile_and_chosen_committee(httpd, tmp_path, capsys):
+    """`agent profile set/show` and `aggregations begin --clerk ...` — the
+    reference README's 'Doing more' aspirations (external-trust profiles,
+    recipient-chosen committees) at the CLI surface."""
+    import json as _json
+
+    url = httpd.address
+
+    def sda(identity, *args, rc=0):
+        got = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity),
+                        *args])
+        assert got == rc, capsys.readouterr()
+        return capsys.readouterr()
+
+    sda("recipient", "agent", "create")
+    sda("recipient", "agent", "keys", "create")
+
+    # profile publish + public read-back through REST
+    sda("clerk-0", "agent", "create")
+    sda("clerk-0", "agent", "profile", "set", "--name", "Clerk Zero",
+        "--keybase", "clerk0", "--website", "https://clerk0.example")
+    own = _json.loads(sda("clerk-0", "agent", "profile", "show").out)
+    assert own["name"] == "Clerk Zero" and own["keybase_id"] == "clerk0"
+    clerk0_id = _json.loads(sda("clerk-0", "agent", "show").out)["id"]
+    seen = _json.loads(
+        sda("recipient", "agent", "profile", "show", clerk0_id).out)
+    assert seen["website"] == "https://clerk0.example"
+
+    # recipient-chosen committee: exact clerks, in the chosen order
+    clerk_ids = [clerk0_id]
+    sda("clerk-0", "agent", "keys", "create")
+    for i in range(1, 4):
+        sda(f"clerk-{i}", "agent", "create")
+        sda(f"clerk-{i}", "agent", "keys", "create")
+        clerk_ids.append(
+            _json.loads(sda(f"clerk-{i}", "agent", "show").out)["id"])
+
+    agg_id = sda("recipient", "aggregations", "create", "chosen",
+                 "--dimension", "4", "--modulus", "433",
+                 "--shares", "3").out.strip()
+    chosen = [clerk_ids[2], clerk_ids[0], clerk_ids[3]]
+    sda("recipient", "aggregations", "begin", agg_id,
+        "--clerk", chosen[0], "--clerk", chosen[1], "--clerk", chosen[2])
+
+    from sda_tpu.client import SdaClient
+    from sda_tpu.crypto import MemoryKeystore
+    from sda_tpu.http import SdaHttpClient
+    from sda_tpu.protocol import AggregationId
+    from sda_tpu.store import Filebased
+
+    proxy = SdaHttpClient(url, store=Filebased(tmp_path / "probe"))
+    ks = MemoryKeystore()
+    probe = SdaClient(SdaClient.new_agent(ks), ks, proxy)
+    probe.upload_agent()
+    committee = proxy.get_committee(probe.agent, AggregationId(agg_id))
+    assert [str(c) for c, _ in committee.clerks_and_keys] == chosen
+
+    # full round still reveals exactly with the chosen committee
+    sda("p1", "participate", agg_id, "1", "2", "3", "4")
+    sda("p2", "participate", agg_id, "4", "3", "2", "1")
+    sda("recipient", "aggregations", "end", agg_id)
+    for i in range(4):
+        sda(f"clerk-{i}", "clerk", "--once")
+    assert sda("recipient", "aggregations", "reveal",
+               agg_id).out.strip() == "5 5 5 5"
+
+    # guard rails: wrong count, keyless clerk
+    err = sda("recipient", "aggregations", "begin", agg_id,
+              "--clerk", chosen[0], rc=1).err
+    assert "exactly 3" in err
+    sda("nokey", "agent", "create")
+    nokey_id = _json.loads(sda("nokey", "agent", "show").out)["id"]
+    err = sda("recipient", "aggregations", "begin", agg_id,
+              "--clerk", chosen[0], "--clerk", chosen[1],
+              "--clerk", nokey_id, rc=1).err
+    assert "not a committee candidate" in err
