@@ -204,6 +204,64 @@ def test_federated_session_exact_round():
     np.testing.assert_array_equal(mean2, -expected)
 
 
+def test_federated_session_packed_shamir_semantics():
+    """FedAvg over Packed-Shamir: values live in Z_m but are SHARED in
+    Z_p (p > m). Negative encodings sit near m, so exactness needs
+    n_participants * m < p — the codec's modulus is m and the final
+    positive() lift mod m recovers the centered sum. Pins that the wrap
+    algebra composes (reference: crypto.rs derived properties +
+    receive.rs:14-21 lift)."""
+    from sda_tpu.crypto import sodium
+
+    if not sodium.available():
+        pytest.skip("libsodium not present")
+    from sda_tpu.fields import numtheory
+    from sda_tpu.protocol import (
+        Aggregation,
+        AggregationId,
+        FullMasking,
+        PackedShamirSharing,
+        SodiumEncryption,
+    )
+    from sda_tpu.server import new_memory_server
+
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    m = 1 << 20  # n * m = 3 * 2^20 << p = 5.4e8: no Z_p wrap
+    dim, n_part = 12, 3
+    service = new_memory_server()
+    recipient = _new_client(service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+    clerks = [_new_client(service) for _ in range(8)]
+    for c in clerks:
+        ck = c.new_encryption_key()
+        c.upload_agent()
+        c.upload_encryption_key(ck)
+    participants = [_new_client(service) for _ in range(n_part)]
+    for part in participants:
+        part.upload_agent()
+
+    template = Aggregation(
+        id=AggregationId.random(), title="fedavg-shamir",
+        vector_dimension=dim, modulus=m,
+        recipient=recipient.agent.id, recipient_key=rkey,
+        masking_scheme=FullMasking(m),
+        committee_sharing_scheme=PackedShamirSharing(3, 8, t, p, w2, w3),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    codec = FixedPointCodec(m, fractional_bits=8, max_summands=n_part)
+    session = FederatedSession(template, codec, recipient, clerks,
+                               participants)
+    rng = np.random.default_rng(9)
+    deltas = rng.normal(0, 100, size=(n_part, dim))  # mixed signs, clipped
+    mean = session.round(list(deltas))
+    expected = np.stack([codec.quantize(d) for d in deltas]).sum(0) \
+        / codec.scale / n_part
+    np.testing.assert_array_equal(mean, expected)
+
+
 # ---------------------------------------------------------------------------
 # secure FedAvg — mesh surface + real training
 
