@@ -135,8 +135,157 @@ def _check_mesh_process_split(mesh, nproc: int) -> None:
         )
 
 
+class _MultihostCheckpointer:
+    """Coordinated per-process snapshots for multihost streamed rounds.
+
+    Every process snapshots its OWN addressable shards of the global
+    accumulators (plus the — identical-everywhere — completed output
+    prefix and tile cursor) to ``path.r{rank}of{n}`` at the same
+    deterministic loop boundaries, rotating TWO slots. A crash can leave
+    ranks one boundary apart (saves are lockstep but not atomic across
+    processes), so resume picks the newest cursor EVERY rank still holds:
+    each rank allgathers its available cursors and the same minimum is
+    chosen everywhere; if the spread exceeds the two-slot history the
+    round restarts from scratch rather than resuming inconsistently.
+    Accumulator shards are re-placed by global index, so resume is
+    bit-identical to an uninterrupted run (same tile/key derivation).
+    """
+
+    SLOTS = 2
+
+    def __init__(self, path, spod, fingerprint):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.nproc = jax.process_count()
+        self.rank = jax.process_index()
+        self.fingerprint = f"{fingerprint}|nproc={self.nproc}|rank={self.rank}"
+        base = f"{path}.r{self.rank}of{self.nproc}"
+        self.paths = [f"{base}.{s}" for s in ("a", "b")]
+        self.sharding = NamedSharding(spod.mesh, P("p", "d"))
+        self._slot = 0
+
+    # -- save --------------------------------------------------------------
+
+    def _acc_payload(self, name, acc):
+        payload = {}
+        if isinstance(acc, np.ndarray):  # d-tile boundary: empty acc
+            payload[f"{name}_host"] = acc
+            return payload
+        payload[f"{name}_shape"] = np.asarray(acc.shape, dtype=np.int64)
+        for j, shard in enumerate(acc.addressable_shards):
+            starts = [
+                (s.start if s.start is not None else 0)
+                for s in shard.index
+            ]
+            payload[f"{name}_{j}_start"] = np.asarray(starts, dtype=np.int64)
+            payload[f"{name}_{j}_data"] = np.asarray(shard.data)
+        return payload
+
+    def save(self, out, done_dims, di, pi, acc_shares, acc_mask):
+        from .streaming import _atomic_npz, _snapshot_header
+
+        payload = _snapshot_header(self.fingerprint, out, done_dims, di, pi)
+        payload.update(self._acc_payload("accS", acc_shares))
+        payload.update(self._acc_payload("accM", acc_mask))
+        _atomic_npz(self.paths[self._slot], **payload)
+        self._slot ^= 1
+
+    # -- load / coordinate -------------------------------------------------
+
+    def _local_candidates(self):
+        """cursor -> path, probing ONLY the cursor header (no accumulator
+        payloads are materialized until the fleet has picked a target)."""
+        from .streaming import _read_snapshot
+
+        cands = {}
+        for path in self.paths:
+            header = _read_snapshot(path, self.fingerprint,
+                                    keys=("done_dims", "di", "pi"))
+            if header is not None:
+                cursor = (int(header["di"]), int(header["pi"]),
+                          int(header["done_dims"]))
+                cands[cursor] = path
+        return cands
+
+    def load(self):
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        from .streaming import _read_snapshot
+
+        cands = self._local_candidates()
+        # encode this rank's available cursors as a fixed [SLOTS, 3] block
+        # (-1 rows = no snapshot) and allgather — every rank computes the
+        # SAME resume decision from the identical gathered table
+        enc = np.full((self.SLOTS, 3), -1, dtype=np.int64)
+        for j, cursor in enumerate(sorted(cands)[: self.SLOTS]):
+            enc[j] = cursor
+        table = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(enc))).reshape(self.nproc, self.SLOTS, 3)
+        per_rank = []
+        for r in range(self.nproc):
+            have = {tuple(int(v) for v in row)
+                    for row in table[r] if row[0] >= 0}
+            if not have:
+                return None  # a rank with no snapshot: fresh start
+            per_rank.append(have)
+        target = min(max(have) for have in per_rank)
+        if any(target not in have for have in per_rank):
+            return None  # spread beyond history: restart, never mix
+        payload = _read_snapshot(cands[target], self.fingerprint)
+        # the full-read outcome must stay a FLEET decision: a snapshot
+        # lost between probe and read on one rank must send every rank
+        # down the fresh-start path together, not split them
+        ok = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray([1 if payload is not None else 0])))
+        if int(ok.sum()) != self.nproc:
+            return None
+        return {
+            "out": payload["out"],
+            "done_dims": payload["done_dims"],
+            "di": payload["di"],
+            "pi": payload["pi"],
+            "_payload": payload,
+        }
+
+    def restore(self, resume):
+        import jax
+
+        payload = resume["_payload"]
+
+        def rebuild(name):
+            shape = tuple(int(v) for v in payload[f"{name}_shape"])
+            blocks = {}
+            j = 0
+            while f"{name}_{j}_data" in payload:
+                starts = tuple(int(v) for v in payload[f"{name}_{j}_start"])
+                blocks[starts] = payload[f"{name}_{j}_data"]
+                j += 1
+
+            def cb(index):
+                starts = tuple(
+                    (s.start if s.start is not None else 0) for s in index
+                )
+                return blocks[starts]
+
+            return jax.make_array_from_callback(shape, self.sharding, cb)
+
+        return rebuild("accS"), rebuild("accM")
+
+    def finish(self):
+        import os
+
+        for path in self.paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
 def streamed_aggregate_process_local(
-    spod, get_local_block, local_participants: int, dimension: int, key=None
+    spod, get_local_block, local_participants: int, dimension: int, key=None,
+    *, checkpoint_path=None, checkpoint_every_chunks: int = 16,
 ):
     """Flagship-scale multihost rounds: every process STREAMS its own
     participant rows through the StreamedPod tile loop.
@@ -150,6 +299,11 @@ def streamed_aggregate_process_local(
     global tile, let alone the global matrix. Aggregation is a sum, so the
     (process-major) global participant ordering is irrelevant to the
     result. Returns the [dimension] aggregate on every process.
+
+    ``checkpoint_path``: coordinated multi-process resume — every process
+    snapshots its own accumulator shards at the same loop boundaries
+    (two-slot history; see _MultihostCheckpointer) and a relaunched fleet
+    resumes bit-identically from the newest cursor all ranks still hold.
     """
     import jax
     import jax.numpy as jnp
@@ -217,10 +371,20 @@ def streamed_aggregate_process_local(
     def fetch(arr):
         return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
+    checkpointer = None
+    if checkpoint_path is not None:
+        checkpointer = _MultihostCheckpointer(
+            checkpoint_path, spod,
+            spod._checkpoint_fingerprint(
+                local_participants * nproc, dimension, key),
+        )
+
     with timed_phase("mesh.multihost_streamed_round"):
         # drive over the GLOBAL participant count so every process iterates
         # the identical tile sequence in lockstep
         return spod.drive_tiles(
             local_participants * nproc, dimension, key,
             make_block=make_block, make_accs=make_accs, fetch=fetch,
+            checkpointer=checkpointer,
+            checkpoint_every_chunks=checkpoint_every_chunks,
         )

@@ -177,10 +177,9 @@ def synthetic_block_provider(
     return get_block
 
 
-def _checkpoint_save(path, fingerprint, out, done_dims, di, pi,
-                     acc_shares, acc_mask):
-    """Atomic, crash-durable resume snapshot: npz to a temp file, fsync,
-    then rename — shared by the single-chip and pod streamed drivers."""
+def _atomic_npz(path, **arrays):
+    """Atomic, crash-durable npz write: temp file, fsync, rename, dir
+    fsync — the durability primitive under every streamed snapshot."""
     import os
     import tempfile
 
@@ -188,14 +187,7 @@ def _checkpoint_save(path, fingerprint, out, done_dims, di, pi,
                                suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(
-                f, fingerprint=np.frombuffer(
-                    fingerprint.encode(), dtype=np.uint8),
-                out=out[:done_dims], done_dims=np.int64(done_dims),
-                di=np.int64(di), pi=np.int64(pi),
-                acc_shares=np.asarray(acc_shares),
-                acc_mask=np.asarray(acc_mask),
-            )
+            np.savez(f, **arrays)
             # data must reach stable storage BEFORE the rename lands, or a
             # power loss leaves a truncated snapshot at the destination
             f.flush()
@@ -221,7 +213,24 @@ def _checkpoint_save(path, fingerprint, out, done_dims, di, pi,
         raise
 
 
-def _checkpoint_load(path, fingerprint):
+def _snapshot_header(fingerprint, out, done_dims, di, pi):
+    """The cursor/prefix fields every streamed snapshot carries — ONE
+    definition shared by the single-process and multihost checkpointers
+    so the formats cannot drift."""
+    return {
+        "fingerprint": np.frombuffer(fingerprint.encode(), dtype=np.uint8),
+        "out": out[:done_dims],
+        "done_dims": np.int64(done_dims),
+        "di": np.int64(di),
+        "pi": np.int64(pi),
+    }
+
+
+def _read_snapshot(path, fingerprint, keys=None):
+    """Fingerprint-guarded snapshot read; ``keys=None`` loads every entry,
+    a key list loads only those (npz members load lazily, so a cursor-only
+    probe does not materialize accumulator payloads). Returns None for a
+    missing/foreign/corrupt snapshot — never trusts one."""
     import os
     import zipfile
 
@@ -231,16 +240,56 @@ def _checkpoint_load(path, fingerprint):
         with np.load(path) as z:
             if bytes(z["fingerprint"]).decode() != fingerprint:
                 return None  # different round/config: start fresh
-            return {k: z[k] for k in
-                    ("out", "done_dims", "di", "pi",
-                     "acc_shares", "acc_mask")}
+            return {k: z[k] for k in (keys if keys is not None else z.files)}
     except (OSError, ValueError, KeyError, EOFError, zipfile.BadZipFile):
         return None  # unreadable/truncated snapshot: start fresh
 
 
+def _checkpoint_load(path, fingerprint):
+    return _read_snapshot(path, fingerprint,
+                          keys=("out", "done_dims", "di", "pi",
+                                "acc_shares", "acc_mask"))
+
+
+class _FileCheckpointer:
+    """Single-process snapshot/resume (the original streamed contract):
+    one atomic npz at ``path``, fingerprint-guarded, removed on
+    completion. ``restore_accs`` re-places loaded host accumulators
+    (identity/`jnp.asarray` single-chip; mesh re-placement for pods)."""
+
+    def __init__(self, path, fingerprint, restore_accs=None):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.restore_accs = restore_accs or (
+            lambda aS, aM: (jnp.asarray(aS), jnp.asarray(aM)))
+
+    def load(self):
+        return _checkpoint_load(self.path, self.fingerprint)
+
+    def restore(self, resume):
+        return self.restore_accs(resume["acc_shares"], resume["acc_mask"])
+
+    def save(self, out, done_dims, di, pi, acc_shares, acc_mask):
+        _atomic_npz(
+            self.path,
+            **_snapshot_header(self.fingerprint, out, done_dims, di, pi),
+            acc_shares=np.asarray(acc_shares),
+            acc_mask=np.asarray(acc_mask),
+        )
+
+    def finish(self):
+        import os
+
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
 def _drive_stream(owner, participants, dimension, key, *, make_block,
                   make_accs, fetch, checkpoint_path=None,
-                  checkpoint_every_chunks=16, restore_accs=None):
+                  checkpoint_every_chunks=16, restore_accs=None,
+                  checkpointer=None):
     """THE streamed tile loop — one definition of the tile/key derivation
     and of the checkpoint/resume state machine, shared by
     StreamingAggregator, StreamedPod, and (via StreamedPod.drive_tiles)
@@ -258,25 +307,27 @@ def _drive_stream(owner, participants, dimension, key, *, make_block,
     pc, dc = owner.participants_chunk, owner.dim_chunk
     out = np.empty(dimension, dtype=np.int64)
     resume = None
-    fingerprint = None
-    if checkpoint_path is not None:
+    if checkpoint_path is not None and checkpointer is None:
         if jax.process_count() > 1:
             raise ValueError(
-                "streamed checkpointing is single-process; multihost "
-                "rounds re-run from scratch (per-process snapshot "
-                "coordination is not implemented)"
+                "checkpoint_path is the single-process snapshot; for "
+                "multihost rounds pass checkpoint_path to "
+                "multihost.streamed_aggregate_process_local, which builds "
+                "the per-process coordinated checkpointer"
             )
-        fingerprint = owner._checkpoint_fingerprint(
-            participants, dimension, key)
-        resume = _checkpoint_load(checkpoint_path, fingerprint)
+        checkpointer = _FileCheckpointer(
+            checkpoint_path,
+            owner._checkpoint_fingerprint(participants, dimension, key),
+            restore_accs,
+        )
+    if checkpointer is not None:
+        resume = checkpointer.load()
         if resume is not None:
             out[: int(resume["done_dims"])] = resume["out"]
     # ground truth for callers recording resumed runs (e.g. benches)
     owner.last_resumed = resume is not None
     resume_di = int(resume["di"]) if resume is not None else -1
     resume_pi = int(resume["pi"]) if resume is not None else 0
-    if restore_accs is None:
-        restore_accs = lambda aS, aM: (jnp.asarray(aS), jnp.asarray(aM))
     empty = np.zeros((0,), owner._field.dtype)
     for di, d0 in enumerate(range(0, dimension, dc)):
         d1 = min(d0 + dc, dimension)
@@ -284,8 +335,7 @@ def _drive_stream(owner, participants, dimension, key, *, make_block,
         if resume is not None and di < resume_di:
             continue  # completed tile: out prefix already restored
         if resume is not None and di == resume_di and resume_pi > 0:
-            acc_shares, acc_mask = restore_accs(
-                resume["acc_shares"], resume["acc_mask"])
+            acc_shares, acc_mask = checkpointer.restore(resume)
             start_pi = resume_pi
         else:
             acc_shares, acc_mask = make_accs(d_size)
@@ -305,14 +355,12 @@ def _drive_stream(owner, participants, dimension, key, *, make_block,
                     jnp.int32(p0), jnp.int32(d0 // 8),
                     acc_shares, acc_mask,
                 )
-            if (checkpoint_path is not None
+            if (checkpointer is not None
                     and checkpoint_every_chunks > 0
                     and (pi + 1) % checkpoint_every_chunks == 0):
                 with timed_phase("stream.checkpoint"):
-                    _checkpoint_save(
-                        checkpoint_path, fingerprint, out, d0, di, pi + 1,
-                        np.asarray(acc_shares), np.asarray(acc_mask),
-                    )
+                    checkpointer.save(out, d0, di, pi + 1,
+                                      acc_shares, acc_mask)
         # sync before the finale so stream.finale times the reconstruct
         # (for pods: psum_scatter + all_gather + reconstruct) alone, not
         # the queued accumulate backlog
@@ -323,17 +371,11 @@ def _drive_stream(owner, participants, dimension, key, *, make_block,
             final = owner._finals[d_size] = owner._final_fn(d_size)
         with timed_phase("stream.finale"):
             out[d0:d1] = fetch(final(acc_shares, acc_mask))[: d1 - d0]
-        if checkpoint_path is not None:
+        if checkpointer is not None:
             with timed_phase("stream.checkpoint"):
-                _checkpoint_save(checkpoint_path, fingerprint, out, d1,
-                                 di + 1, 0, empty, empty)
-    if checkpoint_path is not None:
-        import os
-
-        try:
-            os.unlink(checkpoint_path)  # round complete
-        except OSError:
-            pass
+                checkpointer.save(out, d1, di + 1, 0, empty, empty)
+    if checkpointer is not None:
+        checkpointer.finish()  # round complete
     return out
 
 
@@ -470,8 +512,7 @@ class StreamingAggregator:
             self.surviving_clerks, key,
         )
 
-    # back-compat aliases for the module-level snapshot helpers
-    _checkpoint_save = staticmethod(_checkpoint_save)
+    # back-compat alias for the module-level snapshot loader
     _checkpoint_load = staticmethod(_checkpoint_load)
 
     # -- driver ----------------------------------------------------------
@@ -731,6 +772,7 @@ class StreamedPod:
         *, make_block, make_accs, fetch,
         checkpoint_path: Optional[str] = None,
         checkpoint_every_chunks: int = 16, restore_accs=None,
+        checkpointer=None,
     ) -> np.ndarray:
         """The tile loop shared by single-host streaming and the multihost
         driver (mesh/multihost.py): d-tiles outer, participant tiles inner,
@@ -752,7 +794,7 @@ class StreamedPod:
             make_block=make_block, make_accs=make_accs, fetch=fetch,
             checkpoint_path=checkpoint_path,
             checkpoint_every_chunks=checkpoint_every_chunks,
-            restore_accs=restore_accs,
+            restore_accs=restore_accs, checkpointer=checkpointer,
         )
 
     def aggregate(self, inputs, key=None) -> np.ndarray:

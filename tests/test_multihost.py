@@ -128,3 +128,131 @@ def test_two_process_pod_round():
     for pid, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"process {pid} failed:\n{err[-3000:]}"
         assert f"MULTIHOST_OK process={pid}" in out
+
+
+_CK_WORKER = r"""
+import os
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+port, pid, attempt, ckdir = (sys.argv[1], int(sys.argv[2]),
+                             int(sys.argv[3]), sys.argv[4])
+from sda_tpu.mesh import multihost
+multihost.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+
+import numpy as np
+from sda_tpu.mesh import StreamedPod, make_multislice_mesh
+from sda_tpu.protocol import AdditiveSharing, FullMasking
+
+mesh = make_multislice_mesh(2, 2, 2)
+spod = StreamedPod(
+    AdditiveSharing(share_count=8, modulus=433), FullMasking(433),
+    mesh=mesh, participants_chunk=4, dim_chunk=16,
+)
+
+def rows(process):
+    return np.random.default_rng(40 + process).integers(0, 433, size=(8, 48))
+
+mine = rows(pid)
+calls = {"n": 0}
+
+def provider(lp0, lp1, d0, d1):
+    calls["n"] += 1
+    if attempt == 0 and calls["n"] > 4:
+        # simulate the fleet dying mid-round (both ranks hit the same
+        # lockstep tile, like a preemption)
+        os._exit(3)
+    return mine[lp0:lp1, d0:d1]
+
+out = multihost.streamed_aggregate_process_local(
+    spod, provider, local_participants=8, dimension=48,
+    key=jax.random.PRNGKey(21),
+    checkpoint_path=f"{ckdir}/ck", checkpoint_every_chunks=1,
+)
+np.testing.assert_array_equal(out, (rows(0).sum(0) + rows(1).sum(0)) % 433)
+print(f"CK_OK rank={pid} calls={calls['n']}", flush=True)
+"""
+
+
+def _launch_ck_workers(port, attempt, ckdir):
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return [
+        subprocess.Popen(
+            [sys.executable, "-c", _CK_WORKER, str(port), str(pid),
+             str(attempt), str(ckdir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in range(2)
+    ]
+
+
+def test_multihost_streamed_checkpoint_resume(tmp_path):
+    """The fleet dies mid-round; a relaunch resumes from the coordinated
+    per-rank snapshots and reveals EXACTLY — including the staggered case
+    where one rank's newest snapshot is lost (its slot file deleted, as
+    if that rank crashed before its last save landed): every rank falls
+    back to the newest cursor all of them still hold."""
+    import numpy as np
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    # attempt 0: both ranks die after 4 provider calls. The first exit
+    # can kill the peer through the coordination service (rc 1,
+    # "connection reset") before it reaches its own os._exit(3) — either
+    # death is a valid mid-round crash, and any cursor spread it leaves
+    # is what the two-slot history exists for.
+    procs = _launch_ck_workers(port, 0, tmp_path)
+    for p in procs:
+        out, err = p.communicate(timeout=540)
+        assert p.returncode != 0, (p.returncode, err[-2000:])
+
+    # simulate rank 1 having crashed BEFORE its newest save landed: drop
+    # its newest slot — but only when the surviving (older) cursor still
+    # exists in rank 0's history, else the two-slot spread is exceeded
+    # and the fleet would (correctly) restart from scratch, which is not
+    # the path under test
+    def cursor(path):
+        with np.load(path) as z:
+            return (int(z["di"]), int(z["pi"]), int(z["done_dims"]))
+
+    def rank_slots(rank):
+        return [p for p in (tmp_path / f"ck.r{rank}of2.{s}" for s in "ab")
+                if p.exists()]
+
+    assert rank_slots(1), "rank 1 saved no snapshot"
+    if len(rank_slots(1)) == 2:
+        older, newest = sorted(rank_slots(1), key=cursor)
+        if cursor(older) in {cursor(p) for p in rank_slots(0)}:
+            newest.unlink()
+    # resume is possible iff some cursor exists in both ranks' histories
+    common = ({cursor(p) for p in rank_slots(0)}
+              & {cursor(p) for p in rank_slots(1)})
+    resume_expected = bool(common)
+
+    # attempt 1: fresh processes resume and finish exactly
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port2 = s.getsockname()[1]
+    procs = _launch_ck_workers(port2, 1, tmp_path)
+    full_calls = (16 // 4) * (48 // 16)  # p-tiles x d-tiles = 12
+    for pid, p in enumerate(procs):
+        out, err = p.communicate(timeout=540)
+        assert p.returncode == 0, f"rank {pid} failed:\n{err[-3000:]}"
+        assert f"CK_OK rank={pid}" in out
+        calls = int(out.split("calls=")[1].split()[0])
+        if resume_expected:
+            assert calls < full_calls, (calls, full_calls)
+        else:  # coordinated restart: still exact, full provider sweep
+            assert calls == full_calls, (calls, full_calls)
+
+    # snapshots removed on completion
+    leftovers = list(tmp_path.glob("ck.r*"))
+    assert not leftovers, leftovers
