@@ -634,9 +634,16 @@ def _write_merged(out_path, results, meta):
                 merged[r.get("config")] = r
     except (OSError, ValueError):
         pass
+    allow_downgrade = os.environ.get("SDA_BENCH_ALLOW_DOWNGRADE") == "1"
     for r in results:
         prev = merged.get(r.get("config"))
         if ("error" in r and prev is not None and "error" not in prev):
+            continue
+        if (prev is not None and "error" not in prev
+                and prev.get("platform") == "tpu"
+                and r.get("platform") != "tpu" and not allow_downgrade):
+            # committed hardware evidence outranks a software-rung rerun;
+            # SDA_BENCH_ALLOW_DOWNGRADE=1 overrides deliberately
             continue
         merged[r.get("config")] = r
     ordered = [merged[n] for n in CONFIGS if n in merged]
