@@ -36,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--modulus-bits", type=int, default=28)
     parser.add_argument("--mask", choices=["none", "full", "chacha"],
                         default="full")
+    parser.add_argument("--checkpoint", metavar="PATH",
+                        help="streamed modes: snapshot/resume path "
+                             "(single-process file, or coordinated "
+                             "per-rank snapshots under --multihost)")
     parser.add_argument("--streaming", action="store_true",
                         help="chunked single-chip rounds (HBM-exceeding sizes)")
     parser.add_argument("--participants-chunk", type=int, default=64)
@@ -142,6 +146,10 @@ def main(argv=None) -> int:
     import os
 
     coord = os.environ.get("SDA_SIM_COORD")
+    if args.checkpoint and not args.streaming:
+        print("error: --checkpoint applies to the streamed modes; add "
+              "--streaming", file=sys.stderr)
+        return 1
     if args.multihost and coord is None:
         return _run_multihost(args, argv)
     if coord is not None:
@@ -167,7 +175,7 @@ def main(argv=None) -> int:
     import numpy as np
 
     from ..fields import numtheory
-    from ..mesh import SimulatedPod, StreamingAggregator
+    from ..mesh import SimulatedPod, StreamingAggregator, array_block_provider
     from ..protocol import ChaChaMasking, FullMasking, NoMasking, PackedShamirSharing
 
     if args.sharing == "basic":
@@ -268,6 +276,7 @@ def main(argv=None) -> int:
             out = mh.streamed_aggregate_process_local(
                 spod, lambda lp0, lp1, d0, d1: local[lp0:lp1, d0:d1],
                 local_participants=P_local, dimension=dim, key=key,
+                checkpoint_path=args.checkpoint,
             )
             elapsed = time.perf_counter() - start
             mode = f"multihost x{nproc} streamed mesh {mesh.devices.shape}"
@@ -286,7 +295,10 @@ def main(argv=None) -> int:
             **pod_kwargs,
         )
         start = time.perf_counter()
-        out = np.asarray(agg.aggregate(inputs, key=key))
+        out = np.asarray(agg.aggregate_blocks(
+            array_block_provider(inputs), inputs.shape[0], inputs.shape[1],
+            key, checkpoint_path=args.checkpoint,
+        ))
         elapsed = time.perf_counter() - start
         mode = "streaming"
     else:
