@@ -261,6 +261,15 @@ def test_federated_session_packed_shamir_semantics():
         / codec.scale / n_part
     np.testing.assert_array_equal(mean, expected)
 
+    # fault tolerance composes with the model layer: one clerk never runs
+    # chores, the reconstruction threshold (t + k = 4+3 = 7 of 8) is still
+    # met, and the round reveals the exact mean (crypto.rs:146-153)
+    session_drop = FederatedSession(
+        template, codec, recipient,
+        [c for c in clerks if c is not clerks[5]], participants)
+    mean2 = session_drop.round(list(-deltas))
+    np.testing.assert_array_equal(mean2, -expected)
+
 
 # ---------------------------------------------------------------------------
 # secure FedAvg — mesh surface + real training
