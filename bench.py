@@ -133,6 +133,10 @@ def _run(platform: str, use_pallas: bool) -> dict:
     # everything else through the tunnel. Faster execution wins the
     # headline; both are recorded.
     if os.environ.get("SDA_BENCH_STREAMED", "1" if on_tpu else "0") == "1":
+        # provisional line FIRST: if the streamed attempt hangs a dying
+        # tunnel and the rung child gets killed, the parent still harvests
+        # the monolithic measurement from the dead child's stdout
+        print(json.dumps(result), flush=True)
         try:
             s_res = _run_streamed(scheme, p, inputs, expected, key,
                                   use_pallas, target)
@@ -312,10 +316,27 @@ def _run_rung_subprocess(plat: str, pallas: bool, timeout_s: float):
     except subprocess.TimeoutExpired as e:
         # forward whatever the child said before the hang — that's the
         # diagnostic for exactly the hung-compile case this path targets
+        out_text = ""
         for chunk in (e.stderr, e.stdout):
             if chunk:
-                sys.stderr.write(chunk if isinstance(chunk, str)
-                                 else chunk.decode(errors="replace"))
+                text = (chunk if isinstance(chunk, str)
+                        else chunk.decode(errors="replace"))
+                sys.stderr.write(text)
+                if chunk is e.stdout:
+                    out_text = text
+        # a killed child may still have printed a provisional measurement
+        # (the monolithic line lands before the streamed attempt starts)
+        for line in reversed(out_text.strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "value" in obj:
+                _log(f"rung ({plat}, pallas={pallas}): KILLED after "
+                     f"{timeout_s:.0f}s; provisional measurement kept")
+                obj.setdefault("note", "rung killed mid-run; provisional "
+                                       "measurement from child stdout")
+                return obj
         _log(f"rung ({plat}, pallas={pallas}): KILLED after {timeout_s:.0f}s")
         return None
     dt = time.perf_counter() - t0
