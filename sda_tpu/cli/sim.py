@@ -225,11 +225,6 @@ def main(argv=None) -> int:
                   "back to CPU (tunnel down or SDA_SIM_PLATFORM=cpu)",
                   file=sys.stderr)
             return 1
-        if args.mask == "chacha":
-            print("error: --pallas supports none/full masking only (ChaCha "
-                  "masks come from the versioned wire PRG, which the fused "
-                  "kernel does not generate)", file=sys.stderr)
-            return 1
         from ..fields.fastfield import SolinasPrime
 
         if SolinasPrime.try_from(p) is None:

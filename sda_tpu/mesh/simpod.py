@@ -258,13 +258,15 @@ def _share_sum_stage(scheme, f: FieldOps, M_host, masked, skey):
 
 
 def _pallas_supported(scheme, masking, f: FieldOps) -> bool:
-    """The fused kernel serves packed-Shamir over a Solinas prime with
-    None/Full masking (ChaCha masks must come from the versioned wire PRG,
-    which the kernel does not generate)."""
+    """The fused kernel serves packed-Shamir over a Solinas prime with any
+    masking in the lattice. None/Full draw inside the kernel; ChaCha masks
+    must come from the versioned wire PRG (CHACHA_PRG_V1), so they are
+    applied in a fused XLA pass FIRST and the kernel runs mask-free on the
+    pre-masked input — see _pallas_stage."""
     return (
         isinstance(scheme, SHAMIR_SCHEMES)
         and f.sp is not None
-        and isinstance(masking, (NoMasking, FullMasking))
+        and isinstance(masking, (NoMasking, FullMasking, ChaChaMasking))
     )
 
 
@@ -281,12 +283,13 @@ def _resolve_pallas(scheme, masking, f: FieldOps, use_pallas, what: str) -> bool
     if use_pallas and not active:
         raise ValueError(
             f"pallas {what} step requires packed-Shamir over a Solinas "
-            f"prime with None/Full masking"
+            f"prime (none/full/chacha masking)"
         )
     return active
 
 
 def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
+                  round_key=None, pid_base=0, d_block0=0,
                   interpret: bool = False, external_bits_fn=None):
     """[S, d_loc] canonical residues -> (combined shares [n, B0],
     mask sum [d_loc] | None) on the fused Pallas kernel.
@@ -299,22 +302,39 @@ def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
     kernel's on-core PRNG (or injected external bits) never changes the
     aggregate; tests pin pallas-pod == xla-pod == plain sum.
 
+    ChaCha masking: the mask is the versioned wire PRG (CHACHA_PRG_V1), a
+    function of (round key, global participant id, dim offset) — it is
+    applied by the existing fused XLA _mask_stage pass first, and the
+    kernel then runs mask-free on the pre-masked input; ``round_key``/
+    ``pid_base``/``d_block0`` locate this tile in the global stream
+    exactly like the XLA path.
+
     ``external_bits_fn(key, S, draws, B)`` (tests/util.external_bits
     layout) enables interpret-mode runs on CPU, where the TPU PRNG
     primitive is unavailable.
     """
     from ..fields import pallas_round
+    from ..utils.benchtime import pallas_knobs
+
+    chacha_mask_sum = None
+    if isinstance(masking, ChaChaMasking):
+        x, chacha_mask_sum, _ = _mask_stage(
+            masking, f, x, dev_key, round_key,
+            pid_base=pid_base, d_block0=d_block0,
+        )
+        masking = NoMasking()
 
     S, d_loc = x.shape
     k, t = scheme.secret_count, scheme.privacy_threshold
     masked = isinstance(masking, FullMasking)
     x_cols = sharing.batch_columns(x, k)                    # [S, k, B0]
     B0 = x_cols.shape[-1]
-    p_block = int(os.environ.get("SDA_PALLAS_PBLOCK", 16))
-    env_tile = os.environ.get("SDA_PALLAS_TILE")
-    tile = int(env_tile) if env_tile else (
-        2048 if B0 >= 2048 else max(128, -(-B0 // 128) * 128)
-    )
+    p_block, tile = pallas_knobs()
+    # the tuned tile (swept at flagship widths) must not inflate SMALL
+    # shapes: a 2048 record at B0=8 would pad the kernel's column axis
+    # 256x — clamp to the adaptive per-shape bound
+    shape_tile = 2048 if B0 >= 2048 else max(128, -(-B0 // 128) * 128)
+    tile = shape_tile if tile is None else min(tile, shape_tile)
     pad = (-B0) % tile
     if pad:  # padded columns are sliced off below; their shares never land
         x_cols = jnp.pad(x_cols, ((0, 0), (0, 0), (0, pad)))
@@ -330,7 +350,7 @@ def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
     )
     shares = shares[:, :B0]
     if not masked:
-        return shares, None
+        return shares, chacha_mask_sum
     return shares, sharing.unbatch_columns(mask_tot[:, :B0], d_loc)
 
 
@@ -519,6 +539,8 @@ class SimulatedPod:
             # fused mask+share+combine in one HBM pass (pallas_round.py)
             local_sum, local_mask_sum = _pallas_stage(
                 self.scheme, f, self._M_host, self.masking, x, dev_key,
+                round_key=key, pid_base=pi * P_loc,
+                d_block0=di * (d_loc // 8),
                 interpret=self._pallas_interpret,
                 external_bits_fn=self._pallas_bits_fn,
             )                                                      # [n, B_loc]

@@ -459,6 +459,7 @@ class StreamingAggregator:
                 # fused mask+share+combine in one HBM pass (pallas_round.py)
                 shares, mask_sum = _pallas_stage(
                     s, f, M_host, self.masking, x, key,
+                    round_key=round_key, pid_base=pid0, d_block0=dblk0,
                     interpret=self._pallas_interpret,
                     external_bits_fn=self._pallas_bits_fn,
                 )
@@ -657,6 +658,9 @@ class StreamedPod:
                 # fused mask+share+combine in one HBM pass (pallas_round.py)
                 shares, local_mask_sum = _pallas_stage(
                     s, f, self._M_host, masking, x, dev_key,
+                    round_key=round_key,
+                    pid_base=tile_base + pi * Pc_loc,
+                    d_block0=d_block_base + di * (d_loc // 8),
                     interpret=self._pallas_interpret,
                     external_bits_fn=self._pallas_bits_fn,
                 )

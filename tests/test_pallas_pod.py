@@ -16,7 +16,8 @@ import pytest
 
 from sda_tpu.fields import numtheory
 from sda_tpu.mesh import SimulatedPod, StreamedPod, StreamingAggregator, make_mesh
-from sda_tpu.protocol import ChaChaMasking, FullMasking, NoMasking, PackedShamirSharing
+from sda_tpu.protocol import (AdditiveSharing, ChaChaMasking, FullMasking,
+                              NoMasking, PackedShamirSharing)
 
 from util import external_bits
 
@@ -36,10 +37,11 @@ def needs_devices(n):
 
 @needs_devices(8)
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
-@pytest.mark.parametrize("masking", ["none", "full"])
+@pytest.mark.parametrize("masking", ["none", "full", "chacha"])
 def test_pod_pallas_matches_sum(mesh_shape, masking):
     s = fast_scheme()
-    mask = FullMasking(s.prime_modulus) if masking == "full" else None
+    mask = {"none": None, "full": FullMasking(s.prime_modulus),
+            "chacha": ChaChaMasking(s.prime_modulus, 48, 128)}[masking]
     pod = SimulatedPod(
         s, masking_scheme=mask, mesh=make_mesh(*mesh_shape),
         use_pallas=True, pallas_interpret=True,
@@ -75,10 +77,11 @@ def test_streamed_pod_pallas_matches_sum_and_xla():
     np.testing.assert_array_equal(np.asarray(xla_pod.aggregate(inputs, key)), expected)
 
 
-@pytest.mark.parametrize("masking", ["none", "full"])
+@pytest.mark.parametrize("masking", ["none", "full", "chacha"])
 def test_streaming_aggregator_pallas_matches_sum(masking):
     s = fast_scheme()
-    mask = FullMasking(s.prime_modulus) if masking == "full" else None
+    mask = {"none": None, "full": FullMasking(s.prime_modulus),
+            "chacha": ChaChaMasking(s.prime_modulus, 51, 128)}[masking]
     agg = StreamingAggregator(
         s, masking_scheme=mask, participants_chunk=8, dim_chunk=24,
         use_pallas=True, pallas_interpret=True,
@@ -91,15 +94,36 @@ def test_streaming_aggregator_pallas_matches_sum(masking):
     np.testing.assert_array_equal(out, inputs.sum(axis=0) % s.prime_modulus)
 
 
+@needs_devices(8)
+def test_streamed_pod_pallas_chacha_matches_sum():
+    """ChaCha x pallas on the streamed mesh: the wire-PRG mask expands at
+    each tile's global (participant, dim) offset before the kernel's
+    mask-free pass — wrong tile_base/d_block0 plumbing would corrupt the
+    aggregate on multi-tile runs."""
+    s = fast_scheme()
+    dim = 96  # several dim tiles of 24; all ChaCha-block aligned
+    spod = StreamedPod(
+        s, ChaChaMasking(s.prime_modulus, dim, 128), mesh=make_mesh(4, 2),
+        participants_chunk=8, dim_chunk=24,
+        use_pallas=True, pallas_interpret=True,
+        pallas_external_bits_fn=external_bits,
+    )
+    assert spod.pallas_active
+    rng = np.random.default_rng(8)
+    inputs = rng.integers(0, 1 << 20, size=(20, dim))  # ragged p tiles
+    out = np.asarray(spod.aggregate(inputs, jax.random.PRNGKey(3)))
+    np.testing.assert_array_equal(out, inputs.sum(axis=0) % s.prime_modulus)
+
+
 def test_pallas_gating():
     s = fast_scheme()
     # explicit request over unsupported configs is an error, not a silent
     # fallback
     with pytest.raises(ValueError):
         StreamingAggregator(GOLDEN, use_pallas=True)  # non-Solinas prime
-    with pytest.raises(ValueError):
+    with pytest.raises(ValueError):  # additive sharing: no kernel path
         StreamingAggregator(
-            s, masking_scheme=ChaChaMasking(s.prime_modulus, 48, 128),
+            AdditiveSharing(share_count=8, modulus=s.prime_modulus),
             use_pallas=True,
         )
     # env-driven default falls back silently on unsupported configs
