@@ -294,17 +294,25 @@ def main() -> int:
                     p, seed=3, max_value=1 << 20)
                 # pc variants (pallas only for the extras): 50/100 divide
                 # the flagship's P=100 into unpadded blocks — evidence for
-                # bench.py's SDA_BENCH_STREAM_PC default
-                for use_p, pc in ((False, 64), (True, 64), (True, 50),
-                                  (True, 100)):
+                # bench.py's SDA_BENCH_STREAM_PC default. The final point
+                # runs ChaCha masking through the pallas step (round-3
+                # addition: wire-PRG mask in the fused XLA pass, kernel
+                # mask-free) — on-chip exactness + cost of the hybrid
+                for use_p, pc, mask_kind in (
+                        (False, 64, "full"), (True, 64, "full"),
+                        (True, 50, "full"), (True, 100, "full"),
+                        (True, 64, "chacha")):
                     blocks = [jnp.asarray(
                         prov_dev(i * pc, (i + 1) * pc, 0, dc))
                         for i in range(2)]
                     jax.block_until_ready(blocks)
                     expected_ab = (prov(0, pc, 0, 4096).astype(np.int64)
                                    .sum(axis=0) % p)
+                    masking_ab = (ChaChaMasking(p, dc, 128)
+                                  if mask_kind == "chacha"
+                                  else FullMasking(p))
                     agg = StreamingAggregator(
-                        scheme, FullMasking(p), participants_chunk=pc,
+                        scheme, masking_ab, participants_chunk=pc,
                         dim_chunk=dc, use_pallas=use_p,
                     )
                     sub = agg.aggregate_blocks(prov, pc, 4096, key)
@@ -328,10 +336,12 @@ def main() -> int:
                     jax.device_get(jnp.ravel(disp(0))[0])  # warm/compile
                     per, _i2 = marginal_seconds(disp, target_seconds=5)
                     rate = round(pc * dc / per / 1e9, 2)
-                    _emit("streamed_ab", pallas=use_p, pc=pc, ok=ab_exact,
+                    _emit("streamed_ab", pallas=use_p, pc=pc,
+                          mask=mask_kind, ok=ab_exact,
                           chunk_ms=round(per * 1000, 2), gel_per_sec=rate)
                     ok = ok and ab_exact
-                    if use_p and ab_exact and rate > best_stream.get("rate", 0):
+                    if (use_p and ab_exact and mask_kind == "full"
+                            and rate > best_stream.get("rate", 0)):
                         best_stream.update(pc=pc, rate=rate)
                         # persist IMMEDIATELY (not after the loop): a later
                         # pc variant OOMing or the tunnel dropping must not
