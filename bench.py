@@ -295,6 +295,9 @@ def _run_streamed(scheme, p, inputs, expected, key, use_pallas,
 
 def _child_main(rung: str) -> None:
     """Measurement child: run ONE rung and print its JSON line."""
+    from sda_tpu.utils.benchtime import export_knobs_to_env
+
+    export_knobs_to_env()  # bench entry point opts in to the sweep record
     plat, pallas = rung.rsplit(",", 1)
     print(json.dumps(_run(plat, pallas == "1")))
 

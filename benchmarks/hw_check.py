@@ -277,6 +277,8 @@ def main() -> int:
             # whether the kernel's win carries into the streamed mode
             os.environ["SDA_PALLAS_PBLOCK"] = str(best["p_block"])
             os.environ["SDA_PALLAS_TILE"] = str(best["tile"])
+            # sweep-sourced: small shapes may clamp it (simpod._pallas_stage)
+            os.environ["SDA_PALLAS_TILE_SOURCE"] = "sweep"
             best_stream = {}
             try:
                 from sda_tpu.mesh import (
@@ -286,6 +288,7 @@ def main() -> int:
                 )
 
                 dc = 3 * (1 << 19)
+                ab_exact_dim = 4096  # dims aggregated by the exactness leg
                 prov = synthetic_block_provider32(p, seed=3, max_value=1 << 20)
                 # timing blocks generated ON DEVICE (bit-identical twin
                 # generator): ~1.6 GB of H2D through the flaky tunnel could
@@ -306,17 +309,27 @@ def main() -> int:
                         prov_dev(i * pc, (i + 1) * pc, 0, dc))
                         for i in range(2)]
                     jax.block_until_ready(blocks)
-                    expected_ab = (prov(0, pc, 0, 4096).astype(np.int64)
-                                   .sum(axis=0) % p)
-                    masking_ab = (ChaChaMasking(p, dc, 128)
-                                  if mask_kind == "chacha"
-                                  else FullMasking(p))
-                    agg = StreamingAggregator(
-                        scheme, masking_ab, participants_chunk=pc,
+                    expected_ab = (prov(0, pc, 0, ab_exact_dim)
+                                   .astype(np.int64).sum(axis=0) % p)
+                    # each leg's masking declares the dimension IT actually
+                    # covers (exactness aggregates ab_exact_dim; the timing
+                    # chain drives dim-chunk dc) — same compiled shapes as
+                    # a shared aggregator, but the metadata stays honest if
+                    # dimension validation is ever added
+                    mask_for = ((lambda dd: ChaChaMasking(p, dd, 128))
+                                if mask_kind == "chacha"
+                                else (lambda dd: FullMasking(p)))
+                    agg_exact = StreamingAggregator(
+                        scheme, mask_for(ab_exact_dim), participants_chunk=pc,
                         dim_chunk=dc, use_pallas=use_p,
                     )
-                    sub = agg.aggregate_blocks(prov, pc, 4096, key)
-                    ab_exact = bool(np.array_equal(sub[:4096], expected_ab))
+                    sub = agg_exact.aggregate_blocks(prov, pc, ab_exact_dim, key)
+                    ab_exact = bool(np.array_equal(sub[:ab_exact_dim],
+                                                   expected_ab))
+                    agg = StreamingAggregator(
+                        scheme, mask_for(dc), participants_chunk=pc,
+                        dim_chunk=dc, use_pallas=use_p,
+                    )
                     step = agg._step_fn((pc, dc))
                     B = dc // scheme.secret_count
                     accs = [jnp.zeros((scheme.share_count, B), jnp.uint32),

@@ -573,6 +573,9 @@ CONFIGS = {
 
 def main():
     from sda_tpu.utils.backend import select_platform, use_platform
+    from sda_tpu.utils.benchtime import export_knobs_to_env
+
+    export_knobs_to_env()  # bench entry point opts in to the sweep record
 
     platform = select_platform()
     use_platform(platform)

@@ -314,7 +314,7 @@ def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
     primitive is unavailable.
     """
     from ..fields import pallas_round
-    from ..utils.benchtime import pallas_knobs
+    from ..utils.benchtime import pallas_knobs, tile_from_sweep
 
     chacha_mask_sum = None
     if isinstance(masking, ChaChaMasking):
@@ -330,11 +330,15 @@ def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
     x_cols = sharing.batch_columns(x, k)                    # [S, k, B0]
     B0 = x_cols.shape[-1]
     p_block, tile = pallas_knobs()
-    # the tuned tile (swept at flagship widths) must not inflate SMALL
-    # shapes: a 2048 record at B0=8 would pad the kernel's column axis
-    # 256x — clamp to the adaptive per-shape bound
+    # a SWEEP-sourced tile (tuned at flagship widths) must not inflate
+    # SMALL shapes: a 2048 record at B0=8 would pad the kernel's column
+    # axis 256x — clamp it to the adaptive per-shape bound. An EXPLICIT
+    # user SDA_PALLAS_TILE is honored as-is (padding and all).
     shape_tile = 2048 if B0 >= 2048 else max(128, -(-B0 // 128) * 128)
-    tile = shape_tile if tile is None else min(tile, shape_tile)
+    if tile is None:
+        tile = shape_tile
+    elif tile_from_sweep():
+        tile = min(tile, shape_tile)
     pad = (-B0) % tile
     if pad:  # padded columns are sliced off below; their shares never land
         x_cols = jnp.pad(x_cols, ((0, 0), (0, 0), (0, pad)))

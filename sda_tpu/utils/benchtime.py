@@ -92,37 +92,57 @@ def _knobs_record() -> dict:
 
 
 def pallas_knobs():
-    """(p_block, tile) kernel-tuning knobs, shared by bench.py,
-    benchmarks/suite.py and the sweep harness.
+    """(p_block, tile) kernel-tuning knobs: SDA_PALLAS_PBLOCK /
+    SDA_PALLAS_TILE env vars, else (16, None=auto).
 
-    Priority: SDA_PALLAS_PBLOCK / SDA_PALLAS_TILE env vars, then the
-    hardware-sweep record (see _knobs_record — so fresh processes, the
-    driver's bench run in particular, inherit the tuned values), then
-    (16, None=auto).
+    Env-only by design: library runtime behavior must not depend on the
+    mutable committed sweep artifact (benchmarks/PALLAS_KNOBS.json). The
+    bench entry points (bench.py, benchmarks/suite.py, hw_check) opt in
+    to the file record via ``export_knobs_to_env`` before running.
     """
     import os
 
     pb_env = os.environ.get("SDA_PALLAS_PBLOCK")
     tile_env = os.environ.get("SDA_PALLAS_TILE")
-    pb = int(pb_env) if pb_env else None
-    tile = int(tile_env) if tile_env else None
-    if pb is None or tile is None:
-        rec = _knobs_record()
-        if pb is None and isinstance(rec.get("p_block"), int):
-            pb = rec["p_block"]
-        if tile is None and isinstance(rec.get("tile"), int):
-            tile = rec["tile"]
-    return (pb if pb is not None else 16, tile)
+    return (int(pb_env) if pb_env else 16,
+            int(tile_env) if tile_env else None)
+
+
+def tile_from_sweep() -> bool:
+    """True when SDA_PALLAS_TILE came from a hardware-sweep record (set by
+    export_knobs_to_env / the hw_check sweep) rather than an explicit user
+    override. Sweep-sourced tiles were tuned at flagship widths, so small
+    shapes may clamp them; explicit overrides are honored as-is."""
+    import os
+
+    return os.environ.get("SDA_PALLAS_TILE_SOURCE") == "sweep"
+
+
+def export_knobs_to_env() -> dict:
+    """Opt in to the committed hardware-sweep record: copy its knobs into
+    the SDA_* env vars (where not already set by the user) so everything
+    downstream — including library code that reads env-only pallas_knobs()
+    — inherits the tuned values. Called by the bench entry points ONLY;
+    plain library/test runs never see the file. Returns the record."""
+    import os
+
+    rec = _knobs_record()
+    if isinstance(rec.get("p_block"), int):
+        os.environ.setdefault("SDA_PALLAS_PBLOCK", str(rec["p_block"]))
+    if isinstance(rec.get("tile"), int):
+        if "SDA_PALLAS_TILE" not in os.environ:
+            os.environ["SDA_PALLAS_TILE"] = str(rec["tile"])
+            os.environ["SDA_PALLAS_TILE_SOURCE"] = "sweep"
+    if isinstance(rec.get("stream_pc"), int):
+        os.environ.setdefault("SDA_BENCH_STREAM_PC", str(rec["stream_pc"]))
+    return rec
 
 
 def stream_pc_knob(default: int = 64) -> int:
-    """Streamed participant-chunk size: SDA_BENCH_STREAM_PC env, then the
-    hardware A/B record's stream_pc, then ``default``."""
+    """Streamed participant-chunk size: SDA_BENCH_STREAM_PC env (the
+    hardware A/B record's stream_pc arrives via export_knobs_to_env at
+    bench entry points), else ``default``."""
     import os
 
     env = os.environ.get("SDA_BENCH_STREAM_PC")
-    if env:
-        return int(env)
-    rec = _knobs_record()
-    return rec["stream_pc"] if isinstance(rec.get("stream_pc"), int) \
-        else default
+    return int(env) if env else default

@@ -59,3 +59,53 @@ def test_marginal_time_is_sane_for_known_workload():
     d = _dispatch(1 << 16)
     per, info = marginal_seconds(d, target_seconds=0.5, max_reps=32)
     assert per <= info["probe_s"] * 1.5 + 1e-3
+
+
+def test_pallas_knobs_are_env_only(monkeypatch):
+    # library runtime must not depend on the mutable committed sweep
+    # artifact (ADVICE r3): without env vars the defaults apply even when
+    # a knobs record exists on disk
+    from sda_tpu.utils import benchtime
+
+    monkeypatch.setattr(benchtime, "_knobs_record",
+                        lambda: {"p_block": 64, "tile": 4096,
+                                 "stream_pc": 100})
+    for var in ("SDA_PALLAS_PBLOCK", "SDA_PALLAS_TILE",
+                "SDA_PALLAS_TILE_SOURCE", "SDA_BENCH_STREAM_PC"):
+        monkeypatch.delenv(var, raising=False)
+    assert benchtime.pallas_knobs() == (16, None)
+    assert benchtime.stream_pc_knob() == 64
+    assert not benchtime.tile_from_sweep()
+
+
+def test_export_knobs_to_env_opts_in_and_marks_source(monkeypatch):
+    from sda_tpu.utils import benchtime
+
+    monkeypatch.setattr(benchtime, "_knobs_record",
+                        lambda: {"p_block": 64, "tile": 4096,
+                                 "stream_pc": 100})
+    for var in ("SDA_PALLAS_PBLOCK", "SDA_PALLAS_TILE",
+                "SDA_PALLAS_TILE_SOURCE", "SDA_BENCH_STREAM_PC"):
+        monkeypatch.delenv(var, raising=False)
+    benchtime.export_knobs_to_env()
+    assert benchtime.pallas_knobs() == (64, 4096)
+    assert benchtime.stream_pc_knob() == 100
+    # record-sourced tile is marked so small shapes may clamp it
+    assert benchtime.tile_from_sweep()
+
+
+def test_export_knobs_never_overrides_explicit_env(monkeypatch):
+    from sda_tpu.utils import benchtime
+
+    monkeypatch.setattr(benchtime, "_knobs_record",
+                        lambda: {"p_block": 64, "tile": 4096,
+                                 "stream_pc": 100})
+    monkeypatch.setenv("SDA_PALLAS_TILE", "1024")
+    monkeypatch.setenv("SDA_PALLAS_PBLOCK", "8")
+    monkeypatch.setenv("SDA_BENCH_STREAM_PC", "50")
+    monkeypatch.delenv("SDA_PALLAS_TILE_SOURCE", raising=False)
+    benchtime.export_knobs_to_env()
+    assert benchtime.pallas_knobs() == (8, 1024)
+    assert benchtime.stream_pc_knob() == 50
+    # the explicit tile is NOT sweep-sourced: it must be honored unclamped
+    assert not benchtime.tile_from_sweep()
