@@ -151,6 +151,60 @@ def _run(platform: str, use_pallas: bool) -> dict:
         except Exception as e:  # never lose the monolithic measurement
             result["streamed"] = {
                 "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    # -- dim-tiled monolithic execution of the SAME round -----------------
+    # The round-3 window measured the full-width XLA program superlinear
+    # in d (hw_check timing_check ratio 3.37); the dim-tiled schedule
+    # (lax.scan over fixed-width tiles, see mesh.single_chip_round) keeps
+    # per-tile width constant. Measured as a third candidate; fastest
+    # execution wins the headline, all are recorded.
+    if on_tpu and os.environ.get("SDA_BENCH_TILED", "1") == "1":
+        print(json.dumps(result), flush=True)  # keep prior work harvestable
+        try:
+            from sda_tpu.utils.benchtime import (
+                DEFAULT_DIM_TILE,
+                dim_tile_knob,
+            )
+
+            # the persisted dim_tile verdict comes from a PALLAS-only A/B
+            # (hw_check tiled_ab); on the plain-XLA rung a 0/absent knob
+            # must not disable the schedule that exists to fix the XLA
+            # path's measured superlinearity — default it back on
+            dt = dim_tile_knob() if use_pallas else (
+                dim_tile_knob() or DEFAULT_DIM_TILE)
+            if dt and dt < dim:
+                if use_pallas:
+                    from sda_tpu.fields.pallas_round import (
+                        single_chip_round_pallas,
+                    )
+                    from sda_tpu.utils.benchtime import pallas_knobs
+
+                    p_block, tile = pallas_knobs()
+                    fn_t = jax.jit(single_chip_round_pallas(
+                        scheme, FullMasking(p), p_block=p_block, tile=tile,
+                        dim_tile=dt))
+                else:
+                    fn_t = jax.jit(single_chip_round(
+                        scheme, FullMasking(p), dim_tile=dt))
+                out_t = jax.device_get(fn_t(inputs, key))
+                assert np.array_equal(out_t, expected), \
+                    "dim-tiled round produced wrong aggregate"
+                per_t, t_info = marginal_seconds(
+                    lambda i: fn_t(inputs, jax.random.fold_in(key, i)),
+                    target_seconds=target)
+                v_t = participants * dim / per_t
+                result["dim_tiled"] = {
+                    "value": round(v_t), "dim_tile": dt,
+                    "round_seconds": round(per_t, 5), "exact": True, **t_info}
+                if v_t > result["value"]:
+                    result.update(
+                        value=round(v_t),
+                        vs_baseline=round(v_t / _NORTH_STAR, 4),
+                        execution="dim-tiled monolithic",
+                        round_seconds_marginal=round(per_t, 5),
+                    )
+        except Exception as e:  # never lose the prior measurements
+            result["dim_tiled"] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}"}
     if not on_tpu:
         # CPU fallback (tunnel down): point at the committed real-chip
         # record so the fallback number is not mistaken for chip perf
@@ -365,31 +419,58 @@ def main() -> None:
         return
 
     deadline = time.monotonic() + float(os.environ.get("SDA_BENCH_DEADLINE", 1500))
+    # the up-front probe need not be long: the tunnel gets re-probed
+    # throughout the run below, so a slow start no longer burns 2x300s
+    os.environ.setdefault("SDA_BENCH_TPU_PROBE_TIMEOUT", "120")
     platform = _select_platform()
-    pallas_default = (
-        platform != "cpu" and os.environ.get("SDA_PALLAS", "1") == "1"
-    )
+    pallas_default = os.environ.get("SDA_PALLAS", "1") == "1"
     rung_budget = float(os.environ.get("SDA_BENCH_RUNG_TIMEOUT", 480))
-    # fallback ladder: pallas-TPU -> plain-TPU -> CPU; first rung that
-    # produces a measurement wins, every exit path prints ONE JSON line
-    ladder = [(platform, pallas_default), (platform, False), ("cpu", False)]
-    attempted = []
-    for plat, pallas in ladder:
-        if (plat, pallas) in attempted:
-            continue
-        attempted.append((plat, pallas))
-        remaining = deadline - time.monotonic()
-        if remaining < 60 and plat != "cpu":
-            _log(f"deadline nearly spent; skipping rung ({plat}, pallas={pallas})")
-            continue
-        # the CPU rung always runs: it is the guaranteed-measurement floor,
-        # so it gets a minimum budget even when the TPU rungs ate the deadline
-        timeout_s = (max(remaining, 300) if plat == "cpu"
-                     else min(rung_budget, remaining))
-        result = _run_rung_subprocess(plat, pallas, timeout_s)
+
+    def try_tpu_rungs():
+        """pallas-TPU then plain-TPU; first measurement wins."""
+        for pallas in ([True, False] if pallas_default else [False]):
+            remaining = deadline - time.monotonic()
+            if remaining < 180:  # a TPU rung needs compile time to land
+                _log("deadline nearly spent; skipping remaining TPU rungs")
+                return None
+            result = _run_rung_subprocess(
+                "axon", pallas, min(rung_budget, remaining))
+            if result is not None:
+                return result
+        return None
+
+    if platform != "cpu":
+        result = try_tpu_rungs()
         if result is not None:
             print(json.dumps(result))
             return
+    # TPU rungs failed or the tunnel is down: bank the guaranteed CPU
+    # measurement FIRST, then keep re-probing the tunnel with short probes
+    # spread over the remaining deadline (three rounds of BENCH_r0N.json
+    # landed on the CPU rung while the chip answered either side of the
+    # bench's single up-front probe — round-3 verdict, weak #2/#3)
+    banked = _run_rung_subprocess(
+        "cpu", False, max(deadline - time.monotonic(), 300))
+    from sda_tpu.utils.backend import probe_tpu
+
+    forced_cpu = os.environ.get("SDA_BENCH_PLATFORM") == "cpu"
+    # rung-failure cap: a LIVE tunnel with rungs that still fail (compile
+    # bug, OOM — anything deterministic) must not burn the rest of the
+    # deadline re-spawning known failures; probe failures don't count
+    failed_rounds = 1 if platform != "cpu" else 0
+    while (not forced_cpu and failed_rounds < 2
+           and deadline - time.monotonic() > 240):
+        if probe_tpu(min(90, deadline - time.monotonic() - 200), attempts=1):
+            result = try_tpu_rungs()
+            if result is not None and result.get("platform") != "cpu":
+                print(json.dumps(result))
+                return
+            failed_rounds += 1
+        else:
+            time.sleep(min(30, max(0, deadline - time.monotonic() - 240)))
+    if banked is not None:
+        print(json.dumps(banked))
+        return
     rec = _recorded_tpu_result()
     print(json.dumps({
         "metric": "secure-aggregation bench: no rung finished within the deadline",

@@ -16,10 +16,13 @@ does the full pass in one process:
                                              # benchmarks/HW_WATCH.jsonl
 
 Prints one JSON line per stage; exits 0 only if every stage that ran
-passed. Stages include a ``timing_check`` linearity probe (marginal time at
-dim d vs d/2 must be ~2x) cross-checking the chained-dispatch methodology
-of utils/benchtime.py on chip. Only the SDA_HW_FULL mode writes
-BENCH_SUITE.json (via benchmarks/suite.py with the sweep's best knobs).
+passed. Stages include ``timing_check`` v2: per schedule (full-width and
+dim-tiled), an affine fit of chained-dispatch marginals over >=3
+grain-aligned dims — ok means the measurements are self-consistent, and a
+``classification`` field carries the program-scaling verdict (linear /
+superlinear / affine-with-overhead / inconsistent; see ROOFLINE.md
+'Superlinearity'). Only the SDA_HW_FULL mode writes BENCH_SUITE.json
+(via benchmarks/suite.py with the sweep's best knobs).
 """
 
 from __future__ import annotations
@@ -35,6 +38,52 @@ from sda_tpu.utils.backend import probe_tpu, use_platform
 
 def _emit(stage: str, **kw) -> None:
     print(json.dumps({"stage": stage, **kw}), flush=True)
+
+
+def affine_fit_report(pts, participants: int) -> dict:
+    """Fit marginal = a + b*dim over [(dim, seconds)] points; classify.
+
+    Returns the timing_check record fields: ok means the measurements are
+    SELF-CONSISTENT (clean affine fit with positive slope); the
+    classification separates 'linear' (near-zero intercept, flat
+    per-element cost) from 'superlinear' (per-element cost rising >25%
+    from the smallest to the largest dim — the round-3 full-width
+    signature) and 'affine-with-overhead' (consistent but a real fixed
+    term). Pure host math — unit-tested off-chip so a scarce hardware
+    window can't be burned by a fit bug.
+    """
+    import numpy as np
+
+    ds = np.array([q[0] for q in pts], dtype=np.float64)
+    ts = np.array([q[1] for q in pts], dtype=np.float64)
+    b_slope, a_icept = np.polyfit(ds, ts, 1)
+    pred = a_icept + b_slope * ds
+    max_rel_resid = float(np.max(np.abs(ts - pred) / ts))
+    intercept_frac = float(a_icept / ts[-1])
+    el_cost = ts / ds
+    el_cost_ratio = float(el_cost[-1] / el_cost[0])
+    consistent = bool(max_rel_resid <= 0.10 and b_slope > 0)
+    linear = (consistent and abs(intercept_frac) <= 0.15
+              and el_cost_ratio <= 1.25)
+    classification = (
+        "linear" if linear
+        else "superlinear" if el_cost_ratio > 1.25
+        else "affine-with-overhead" if consistent
+        else "inconsistent")
+    return {
+        "ok": consistent,
+        "classification": classification,
+        "points": [{"dim": int(dd), "ms": round(t * 1000, 3),
+                    "gel_per_sec": round(participants * dd / t / 1e9, 2)}
+                   for dd, t in pts],
+        "model": {"intercept_ms": round(float(a_icept) * 1000, 3),
+                  "ns_per_dim": round(float(b_slope) * 1e9, 4)},
+        "max_rel_resid": round(max_rel_resid, 4),
+        "intercept_frac": round(intercept_frac, 3),
+        "el_cost_ratio_last_vs_first": round(el_cost_ratio, 3),
+        "ratio_full_half": (round(float(ts[-1] / ts[1]), 3)
+                            if len(ts) >= 4 else None),
+    }
 
 
 def main() -> int:
@@ -98,14 +147,21 @@ def main() -> int:
         return 0 if ok else 1
 
     # -- headline timings (marginal method; see utils/benchtime.py) -------
+    from sda_tpu.utils.benchtime import DEFAULT_DIM_TILE
+
     P, d = 100, 999_999
     host_big = rng.integers(0, 1 << 20, size=(P, d), dtype=np.uint32)
     expected_big = host_big.astype(np.int64).sum(axis=0) % p
     big = jnp.asarray(host_big)
     fn_xla = jax.jit(single_chip_round(scheme, FullMasking(p)))
+    fn_xla_tiled = jax.jit(single_chip_round(
+        scheme, FullMasking(p), dim_tile=DEFAULT_DIM_TILE))
     for name, build in [
         ("pallas", lambda: jax.jit(single_chip_round_pallas(scheme, FullMasking(p)))),
+        ("pallas_tiled", lambda: jax.jit(single_chip_round_pallas(
+            scheme, FullMasking(p), dim_tile=DEFAULT_DIM_TILE))),
         ("xla", lambda: fn_xla),
+        ("xla_tiled", lambda: fn_xla_tiled),
     ]:
         try:
             fn = build()
@@ -123,45 +179,55 @@ def main() -> int:
                   error=f"{type(e).__name__}: {str(e)[:300]}")
             ok = False
 
-    # -- timing-methodology cross-check (round-2 verdict, weak #4) --------
+    # -- timing-methodology cross-check v2 (round-3 verdict, weak #3) -----
     # The chained-dispatch marginal method is the single source of every
-    # committed TPU number, so validate it against physics on chip: halving
-    # the dimension must halve the marginal time (the kernel is O(P*d) with
-    # no d-dependent fixed costs). The half-size input is a device-side
-    # slice of the already-uploaded buffer — no new host->device transfer
-    # over the flaky tunnel. A fixed overhead mistakenly counted as compute
-    # would push the ratio below 2; an under-synchronized chain (the failure
-    # mode that read 3.8e12 el/s through the tunnel) shows up as a ratio
-    # near 1.
-    # Advisory, not gating: a jitter blip between the two marginal runs must
-    # not forfeit a rare hardware window (the sweep/suite below still runs,
-    # and --watch still records the evidence); the recorded ratio is the
-    # cross-check artifact either way.
-    try:
-        # keep the half dim on the scheme's packing grain (secret_count x
-        # ChaCha block): 999999/2 pads differently from the full size and
-        # the padding delta skews the ratio (observed 3.37 in round 3's
-        # first window)
-        half_d = (d // 2 // 24) * 24
-        half = big[:, :half_d]
-        # fn_xla is already compiled for the full shape; only the half
-        # shape needs a fresh trace (same jitted closure, new shape)
-        jax.device_get(fn_xla(half, key))
-        per_full, _ = marginal_seconds(
-            lambda i: fn_xla(big, jax.random.fold_in(key, i)), target_seconds=6
-        )
-        per_half, _ = marginal_seconds(
-            lambda i: fn_xla(half, jax.random.fold_in(key, i)), target_seconds=6
-        )
-        ratio = per_full / per_half
-        lin_ok = abs(ratio - 2.0) <= 0.2  # within 10% of 2x
-        _emit("timing_check", ok=lin_ok, ratio=round(ratio, 3),
-              ms_full=round(per_full * 1000, 2),
-              ms_half=round(per_half * 1000, 2),
-              detail="marginal time must scale linearly in dim (advisory)")
-    except Exception as e:
-        _emit("timing_check", ok=False,
-              error=f"{type(e).__name__}: {str(e)[:300]}")
+    # committed TPU number. Round 3's two-point probe (full vs half dim,
+    # expect ratio ~2) measured 3.37 and shipped unexplained. v2 measures
+    # >=3 grain-aligned dims per schedule and fits marginal = a + b*dim by
+    # least squares:
+    #   - max relative residual <= 0.10  -> measurements are SELF-
+    #     CONSISTENT (an under-synchronized chain — the failure mode that
+    #     once read 3.8e12 el/s — cannot produce a clean affine fit);
+    #   - intercept_frac ~ 0             -> cost is LINEAR in d, the old
+    #     probe's expectation;
+    #   - a clean fit with a large NEGATIVE intercept, or a poor affine
+    #     fit with per-element cost rising in d, means the full-width
+    #     program is genuinely SUPERLINEAR (the round-3 ratio 3.37 implies
+    #     per-element cost 1.7x worse at d than at d/2) — a program
+    #     property, not a probe artifact; the dim-tiled schedule
+    #     (single_chip_round dim_tile=...) exists to fix exactly that and
+    #     is fitted alongside, where tiles of constant width make cost
+    #     affine in d by construction.
+    # Advisory, not gating: a jitter blip must not forfeit a rare hardware
+    # window; the recorded fits are the cross-check artifact either way.
+    per_full = None
+    for path_name, path_fn, fit_dims in [
+        ("xla_fullwidth", fn_xla,
+         [(d // 4 // 24) * 24, (d // 2 // 24) * 24, (3 * d // 4 // 24) * 24, d]),
+        # tiled dims = whole multiples of the tile (1, 2, 3 tiles): zero
+        # padding, so the fit sees pure schedule scaling
+        ("xla_tiled", fn_xla_tiled,
+         [DEFAULT_DIM_TILE, 2 * DEFAULT_DIM_TILE, d]),
+    ]:
+        try:
+            pts = []
+            for dd in fit_dims:
+                sub = big if dd == d else big[:, :dd]
+                jax.device_get(jnp.ravel(path_fn(sub, key))[0])  # compile
+                per, _ = marginal_seconds(
+                    lambda i: path_fn(sub, jax.random.fold_in(key, i)),
+                    target_seconds=4,
+                )
+                pts.append((int(dd), per))
+            report = affine_fit_report(pts, P)
+            if path_name == "xla_fullwidth":
+                per_full = pts[-1][1]  # trace_check compares this below
+            _emit("timing_check", path=path_name, **report,
+                  detail="affine fit of chained-dispatch marginals over "
+                         "dim (advisory; see ROOFLINE.md 'Superlinearity')")
+        except Exception as e:
+            _emit("timing_check", path=path_name, ok=False,
+                  error=f"{type(e).__name__}: {str(e)[:300]}")
 
     # -- profiler-trace cross-check (advisory, round-2 verdict weak #4) ---
     # second independent check on the marginal method: capture a profiler
@@ -192,11 +258,11 @@ def main() -> int:
         else:
             dev_s = stats[module]["median_us"] / 1e6
             # compare against the xla marginal number measured above when
-            # it exists (per_full from timing_check scope)
-            try:
+            # it exists (per_full from the timing_check fit)
+            if per_full:
                 ratio = dev_s / per_full
                 agree = 0.5 <= ratio <= 2.0
-            except NameError:
+            else:
                 ratio, agree = None, None
             _emit("trace_check", ok=agree, module=module,
                   device_median_s=round(dev_s, 5),
@@ -279,6 +345,36 @@ def main() -> int:
             os.environ["SDA_PALLAS_TILE"] = str(best["tile"])
             # sweep-sourced: small shapes may clamp it (simpod._pallas_stage)
             os.environ["SDA_PALLAS_TILE_SOURCE"] = "sweep"
+            # dim-tiled monolithic A/B at the swept-best knobs: does the
+            # scan-over-dim-tiles schedule beat the full-width kernel on
+            # the flagship shape? The measured winner is persisted as the
+            # dim_tile knob (0 = untiled won) and inherited by bench.py
+            # via export_knobs_to_env
+            try:
+                fn_t = jax.jit(single_chip_round_pallas(
+                    scheme, FullMasking(p), p_block=best["p_block"],
+                    tile=best["tile"], dim_tile=DEFAULT_DIM_TILE))
+                out_t = jax.device_get(fn_t(big, key))
+                t_exact = bool(np.array_equal(out_t, expected_big))
+                per_t, _ti = marginal_seconds(
+                    lambda i: fn_t(big, jax.random.fold_in(key, i)),
+                    target_seconds=4)
+                tiled_rate = round(P * d / per_t / 1e9, 2)
+                tiled_wins = t_exact and tiled_rate > best["gel_per_sec"]
+                _emit("tiled_ab", ok=t_exact, dim_tile=DEFAULT_DIM_TILE,
+                      gel_per_sec=tiled_rate,
+                      untiled_gel_per_sec=best["gel_per_sec"],
+                      winner="tiled" if tiled_wins else "untiled")
+                with open(knobs_path) as kf:
+                    rec = json.load(kf)
+                rec["dim_tile"] = DEFAULT_DIM_TILE if tiled_wins else 0
+                rec["dim_tile_gel_per_sec"] = tiled_rate
+                with open(tmp_path, "w") as kf:
+                    json.dump(rec, kf, indent=2)
+                os.replace(tmp_path, knobs_path)
+            except Exception as e:
+                _emit("tiled_ab", ok=False,
+                      error=f"{type(e).__name__}: {str(e)[:300]}")
             best_stream = {}
             try:
                 from sda_tpu.mesh import (

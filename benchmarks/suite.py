@@ -606,6 +606,11 @@ def main():
                       "error": f"{type(e).__name__}: {e}"}
         result.setdefault("platform", dev.platform)
         result["recorded_at"] = _utc_now()
+        result.setdefault(
+            "provenance",
+            f"benchmarks/suite.py on {dev.platform}"
+            + (" (SDA_BENCH_FULL)" if os.environ.get("SDA_BENCH_FULL") == "1"
+               else ""))
         results.append(result)
         print(json.dumps(result), flush=True)
         # re-record after EVERY config: hardware windows die mid-suite
@@ -619,6 +624,41 @@ def _utc_now() -> str:
 
     return datetime.datetime.now(datetime.timezone.utc).isoformat(
         timespec="seconds")
+
+
+#: records more than this much older than the newest record are from an
+#: earlier window (a hardware window is bounded by SDA_HW_WINDOW_TIMEOUT,
+#: default 2h, so 3h separates windows conservatively)
+_WINDOW_SPAN_S = 3 * 3600
+
+
+def _stamp_stale(merged: dict) -> None:
+    """Mark records from earlier windows with stale:true (in place).
+
+    A reader of BENCH_SUITE.json must be able to tell a fresh record from
+    a survivor of an old window without diffing git history (round-3
+    verdict, weak #5): any record without recorded_at, or recorded_at more
+    than _WINDOW_SPAN_S older than the newest record in the file, carries
+    an explicit ``stale: true``; fresh records carry no flag.
+    """
+    import datetime
+
+    def ts(r):
+        try:
+            return datetime.datetime.fromisoformat(r["recorded_at"])
+        except (KeyError, TypeError, ValueError):
+            return None
+    stamps = {c: ts(r) for c, r in merged.items()}
+    newest = max((t for t in stamps.values() if t is not None), default=None)
+    for c, r in merged.items():
+        t = stamps[c]
+        is_stale = t is None or (
+            newest is not None
+            and (newest - t).total_seconds() > _WINDOW_SPAN_S)
+        if is_stale:
+            r["stale"] = True
+        else:
+            r.pop("stale", None)
 
 
 def _write_merged(out_path, results, meta):
@@ -649,6 +689,7 @@ def _write_merged(out_path, results, meta):
             # SDA_BENCH_ALLOW_DOWNGRADE=1 overrides deliberately
             continue
         merged[r.get("config")] = r
+    _stamp_stale(merged)
     ordered = [merged[n] for n in CONFIGS if n in merged]
     ordered += [r for c, r in merged.items() if c not in CONFIGS]
     # the header records where the MERGED results ran, not just this run —

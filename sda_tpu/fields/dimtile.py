@@ -1,0 +1,58 @@
+"""Dim-tiled round schedule: lax.scan over fixed-width dimension tiles.
+
+The round-3 hardware window measured the full-width single-chip round
+SUPERLINEAR in d (marginal 25.8ms at d~1M vs 7.7ms at d/2 — per-element
+cost 1.7x worse at full width; benchmarks/ROOFLINE.md 'Superlinearity').
+Scanning fixed-width tiles keeps every tile on the fast side of that
+cliff and makes round cost affine in d by construction. Shared by the
+XLA (mesh.single_chip_round) and Pallas (fields.pallas_round) drivers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def scan_dim_tiles(one_tile, grain: int, dim_tile: int):
+    """Wrap a per-tile round into a full-round function.
+
+    ``one_tile(blk, round_key, tile_key, tile_idx, width)`` computes a
+    complete round over ``blk`` ([P, width] raw inputs) and returns the
+    [width] int64 aggregate; ``tile_idx`` may be traced. ``grain`` is the
+    tile-width quantum (whole packing columns x whole ChaCha blocks).
+
+    Returns ``round_fn(inputs, key)``. Inputs narrower than one tile run
+    ``one_tile`` directly (no pad/scan machinery — a wide tile knob must
+    not inflate small shapes); everything else runs the scan, INCLUDING
+    the exactly-one-tile case, so timing points at 1, 2, ... tiles all
+    measure the same schedule (a fit mixing the untiled program into its
+    first point would misclassify the tiled schedule).
+    """
+    if dim_tile <= 0:
+        raise ValueError(f"dim_tile must be positive, got {dim_tile}")
+    T = -(-int(dim_tile) // grain) * grain
+
+    def round_fn(inputs, key):
+        P, d = inputs.shape
+        if d < T:
+            return one_tile(inputs, key, key, jnp.int32(0), d)
+        n_tiles = -(-d // T)
+        pad = n_tiles * T - d
+        if pad:  # zero columns aggregate as zero; sliced off below
+            inputs = jnp.pad(inputs, ((0, 0), (0, pad)))
+        xt = jnp.moveaxis(
+            inputs.reshape(P, n_tiles, T), 1, 0)  # [n_tiles, P, T]
+
+        def body(_, blk_i):
+            blk, i = blk_i
+            # fold_in keeps tile randomness streams distinct (exactness
+            # never depends on it — masks cancel and random polynomial
+            # rows are annihilated by reconstruction)
+            return None, one_tile(blk, key, jax.random.fold_in(key, i), i, T)
+
+        _, tiles = jax.lax.scan(
+            body, None, (xt, jnp.arange(n_tiles, dtype=jnp.int32)))
+        return tiles.reshape(-1)[:d]
+
+    return round_fn

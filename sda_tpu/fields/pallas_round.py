@@ -299,11 +299,16 @@ def single_chip_round_pallas(
     external_bits_fn=None,
     p_block: int = 16,
     p_tile: Optional[int] = None,
+    dim_tile: Optional[int] = None,
 ):
     """Drop-in alternative to mesh.single_chip_round on the fused kernel.
 
     Requires a Solinas prime. external_bits_fn(key, P, draws, B) -> uint32
-    bits array enables deterministic/interpret-mode testing.
+    bits array enables deterministic/interpret-mode testing. ``dim_tile``
+    processes the dimension in fixed-width tiles via ``lax.scan`` — one
+    complete kernel round per tile — mirroring mesh.single_chip_round's
+    dim-tiled schedule (the full-width program measured superlinear in d
+    on chip; see that docstring).
     """
     from ..protocol import FullMasking, NoMasking
 
@@ -325,7 +330,7 @@ def single_chip_round_pallas(
     t = s.privacy_threshold
     draws = (k + t) if masked else t
 
-    def round_fn(inputs, key):
+    def one_tile(inputs, key):
         P, d = inputs.shape
         x = fastfield.to_residues32(inputs, sp)
         x_cols = batch_columns(x, k)                               # [P, k, B0]
@@ -369,4 +374,14 @@ def single_chip_round_pallas(
             total = modsub32(total, mask_flat, sp)
         return total.astype(jnp.int64)
 
-    return round_fn
+    if dim_tile is None:
+        return one_tile
+
+    import math
+
+    from .dimtile import scan_dim_tiles
+
+    grain = k * 8 // math.gcd(k, 8)
+    return scan_dim_tiles(
+        lambda blk, round_key, tile_key, i, width: one_tile(blk, tile_key),
+        grain, dim_tile)

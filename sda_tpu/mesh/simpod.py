@@ -650,6 +650,7 @@ class SimulatedPod:
 def single_chip_round(
     sharing_scheme: LinearSecretSharingScheme,
     masking_scheme: Optional[LinearMaskingScheme] = None,
+    dim_tile: Optional[int] = None,
 ):
     """Collective-free full aggregation round, jittable on one device.
 
@@ -659,6 +660,17 @@ def single_chip_round(
     moduli the whole round runs on the uint32 fast path (fields.fastfield);
     results are bit-identical either way. ChaCha masking requires the
     dimension to be a multiple of 8 (one ChaCha block).
+
+    ``dim_tile``: process the dimension in fixed-width tiles via
+    ``lax.scan`` instead of one full-width program. The round-3 hardware
+    window measured the full-width XLA program SUPERLINEAR in d (marginal
+    25.8ms at d~1M vs 7.7ms at d/2 — ratio 3.4, i.e. per-element cost
+    1.7x worse at full width; HW_WATCH.jsonl timing_check), so tiling the
+    dim axis keeps every tile on the fast side of that cliff and makes
+    round cost linear in d by construction. Exact for any tile width:
+    each tile is a complete mask->share->combine->reconstruct->unmask
+    round over its own columns (masks cancel per tile; ChaCha tiles read
+    their window of the global stream via d_block0).
     """
     scheme = sharing_scheme
     masking = masking_scheme or NoMasking()
@@ -669,18 +681,34 @@ def single_chip_round(
     _check_mask_modulus(masking, scheme)
     M_host, L_host = _build_matrices(scheme)
     f = FieldOps.create(_scheme_modulus(scheme))
+    # tile grain: whole packing columns (input_size) and whole ChaCha
+    # blocks (8 u64 draws) — same grain as the streaming driver
+    grain = scheme.input_size * 8 // math.gcd(scheme.input_size, 8)
 
-    def round_fn(inputs, key):
-        P_total, d = inputs.shape
-        x = f.to_residues(inputs)
+    def one_tile(x, bkey, round_key, d_block0, d_loc):
         masked, mask_total, skey = _mask_stage(
-            masking, f, x, key, key, pid_base=0, d_block0=0
+            masking, f, x, bkey, round_key, pid_base=0, d_block0=d_block0
         )
         # share + clerk combine fused via linearity (see _share_sum_stage)
         combined = _share_sum_stage(scheme, f, M_host, masked, skey)  # [n, B]
-        masked_total = _reconstruct_stage(scheme, f, L_host, combined, d)
+        masked_total = _reconstruct_stage(scheme, f, L_host, combined, d_loc)
         if mask_total is None:
             return f.to_int64(masked_total)
         return f.to_int64(f.sub(masked_total, mask_total))
 
-    return round_fn
+    if dim_tile is None:
+        def round_fn(inputs, key):
+            P_total, d = inputs.shape
+            return one_tile(f.to_residues(inputs), key, key, 0, d)
+
+        return round_fn
+
+    from ..fields.dimtile import scan_dim_tiles
+
+    def tile_body(blk, round_key, tile_key, i, width):
+        # per-tile residue conversion fuses into the tile program; the
+        # ChaCha block counter locates this tile in the global stream
+        return one_tile(f.to_residues(blk), tile_key, round_key,
+                        i * (width // 8), width)
+
+    return scan_dim_tiles(tile_body, grain, dim_tile)

@@ -135,7 +135,26 @@ def export_knobs_to_env() -> dict:
             os.environ["SDA_PALLAS_TILE_SOURCE"] = "sweep"
     if isinstance(rec.get("stream_pc"), int):
         os.environ.setdefault("SDA_BENCH_STREAM_PC", str(rec["stream_pc"]))
+    if isinstance(rec.get("dim_tile"), int):
+        os.environ.setdefault("SDA_PALLAS_DIMTILE", str(rec["dim_tile"]))
     return rec
+
+
+#: default monolithic dim-tile width: 24-grain aligned, 3 tiles at the
+#: flagship d=999999 with 9 padded columns (the round-3 window measured
+#: the full-width program superlinear in d; tiles stay on the fast side)
+DEFAULT_DIM_TILE = 333336
+
+
+def dim_tile_knob(default: int = DEFAULT_DIM_TILE):
+    """Monolithic dim-tile width: SDA_PALLAS_DIMTILE env (0 disables
+    tiling -> None), else ``default``. The hardware A/B record's dim_tile
+    arrives via export_knobs_to_env at bench entry points."""
+    import os
+
+    env = os.environ.get("SDA_PALLAS_DIMTILE")
+    val = int(env) if env else default
+    return val if val > 0 else None
 
 
 def stream_pc_knob(default: int = 64) -> int:

@@ -1,0 +1,105 @@
+"""benchmarks/suite.py record merging — the BENCH_SUITE.json provenance
+contract (round-3 verdict, weak #5).
+
+The merge must (a) never let an error stub or a CPU rerun clobber committed
+hardware evidence, and (b) stamp every record from an earlier window with an
+explicit stale flag so a reader can tell fresh records from survivors
+without diffing git history.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+_SUITE_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks", "suite.py")
+_spec = importlib.util.spec_from_file_location("bench_suite", _SUITE_PATH)
+suite = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(suite)
+
+
+def _read(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _merge(tmp_path, results, meta=None):
+    out = os.path.join(tmp_path, "BENCH_SUITE.json")
+    suite._write_merged(out, results, meta or {"platform": "cpu"})
+    return _read(out)
+
+
+def test_merge_stamps_missing_timestamp_as_stale(tmp_path):
+    data = _merge(tmp_path, [
+        {"config": "packed-1m", "value": 1.0, "platform": "tpu",
+         "recorded_at": "2026-07-30T15:40:15+00:00"},
+        {"config": "mobilenet-3.5m", "value": 2.0, "platform": "tpu"},
+    ])
+    by = {r["config"]: r for r in data["results"]}
+    assert "stale" not in by["packed-1m"]
+    assert by["mobilenet-3.5m"]["stale"] is True
+
+
+def test_merge_stamps_earlier_window_stale_and_fresh_clears_it(tmp_path):
+    old = {"config": "lora-13m", "value": 1.0, "platform": "tpu",
+           "recorded_at": "2026-07-28T10:00:00+00:00"}
+    new = {"config": "packed-1m", "value": 2.0, "platform": "tpu",
+           "recorded_at": "2026-07-30T15:00:00+00:00"}
+    data = _merge(tmp_path, [old, new])
+    by = {r["config"]: r for r in data["results"]}
+    assert by["lora-13m"]["stale"] is True
+    assert "stale" not in by["packed-1m"]
+    # a fresh re-record of the stale config clears the flag
+    data = _merge(tmp_path, [
+        {"config": "lora-13m", "value": 3.0, "platform": "tpu",
+         "recorded_at": "2026-07-30T15:30:00+00:00"}])
+    by = {r["config"]: r for r in data["results"]}
+    assert "stale" not in by["lora-13m"]
+    assert by["lora-13m"]["value"] == 3.0
+
+
+def test_merge_same_window_records_not_stale(tmp_path):
+    # two records an hour apart are the same window (span threshold 3h)
+    data = _merge(tmp_path, [
+        {"config": "packed-1m", "value": 1.0, "platform": "tpu",
+         "recorded_at": "2026-07-30T14:45:00+00:00"},
+        {"config": "lenet-60k", "value": 2.0, "platform": "tpu",
+         "recorded_at": "2026-07-30T15:40:00+00:00"},
+    ])
+    assert all("stale" not in r for r in data["results"])
+
+
+def test_merge_error_stub_never_replaces_good_record(tmp_path):
+    good = {"config": "packed-1m", "value": 5.0, "platform": "tpu",
+            "recorded_at": "2026-07-30T15:00:00+00:00"}
+    _merge(tmp_path, [good])
+    data = _merge(tmp_path, [
+        {"config": "packed-1m", "error": "Boom", "platform": "cpu",
+         "recorded_at": "2026-07-30T16:00:00+00:00"}])
+    by = {r["config"]: r for r in data["results"]}
+    assert by["packed-1m"]["value"] == 5.0
+    assert "error" not in by["packed-1m"]
+
+
+def test_merge_cpu_rerun_never_downgrades_tpu_record(tmp_path):
+    tpu = {"config": "packed-1m", "value": 5.0, "platform": "tpu",
+           "recorded_at": "2026-07-30T15:00:00+00:00"}
+    _merge(tmp_path, [tpu])
+    data = _merge(tmp_path, [
+        {"config": "packed-1m", "value": 0.1, "platform": "cpu",
+         "recorded_at": "2026-07-31T15:00:00+00:00"}])
+    by = {r["config"]: r for r in data["results"]}
+    assert by["packed-1m"]["platform"] == "tpu"
+    assert by["packed-1m"]["value"] == 5.0
+    # the rejected downgrade contributed no newer record, so the surviving
+    # TPU evidence is still the newest window: not stale
+    assert "stale" not in by["packed-1m"]
+    # once ANOTHER config lands from a later window, the old TPU record is
+    # visibly from an earlier one
+    data = _merge(tmp_path, [
+        {"config": "paillier-2048", "value": 9.0, "platform": "host",
+         "recorded_at": "2026-07-31T15:00:00+00:00"}])
+    by = {r["config"]: r for r in data["results"]}
+    assert by["packed-1m"]["stale"] is True
+    assert "stale" not in by["paillier-2048"]

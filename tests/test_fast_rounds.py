@@ -88,6 +88,59 @@ def test_large_committee_scheme_round():
     np.testing.assert_array_equal(out, inputs.sum(axis=0) % p)
 
 
+@pytest.mark.parametrize("masking", ["none", "full", "chacha"])
+@pytest.mark.parametrize("dim", [96, 123, 240, 241])  # 96 = exactly 1 tile
+def test_single_chip_round_dim_tiled_exact(masking, dim):
+    """The dim-tiled schedule (lax.scan over fixed-width tiles) must be
+    bit-exact vs plain aggregation for every masking scheme, including a
+    ragged last tile and dims off the tile grain. ChaCha pins that each
+    tile reads ITS window of the global mask stream (d_block0)."""
+    from sda_tpu.protocol import ChaChaMasking
+
+    s = fast_scheme()
+    p = s.prime_modulus
+    d_cha = -(-dim // 8) * 8  # chacha requires whole 8-dim blocks
+    d = d_cha if masking == "chacha" else dim
+    mask = {"none": NoMasking(), "full": FullMasking(p),
+            "chacha": ChaChaMasking(p, d, 128)}[masking]
+    fn = jax.jit(single_chip_round(s, mask, dim_tile=96))
+    rng = np.random.default_rng(11)
+    inputs = rng.integers(0, 1 << 20, size=(9, d))
+    out = np.asarray(fn(jax.numpy.asarray(inputs), jax.random.PRNGKey(7)))
+    np.testing.assert_array_equal(out, inputs.sum(axis=0) % p)
+
+
+def test_single_chip_round_dim_tile_wider_than_dim_is_untiled():
+    s = fast_scheme()
+    fn = jax.jit(single_chip_round(s, FullMasking(s.prime_modulus),
+                                   dim_tile=4096))
+    rng = np.random.default_rng(12)
+    inputs = rng.integers(0, 1 << 20, size=(5, 60))
+    out = np.asarray(fn(jax.numpy.asarray(inputs), jax.random.PRNGKey(8)))
+    np.testing.assert_array_equal(out, inputs.sum(axis=0) % s.prime_modulus)
+
+
+@pytest.mark.parametrize("dim", [384, 250])
+def test_pallas_round_dim_tiled_exact(dim):
+    """Dim-tiled pallas driver (interpret mode): one kernel round per tile
+    scanned over the dim axis, exact incl. ragged tails off the grain."""
+    import jax.numpy as jnp
+
+    from sda_tpu.fields.pallas_round import single_chip_round_pallas
+    from util import external_bits as ext
+
+    s = fast_scheme()
+    p = s.prime_modulus
+    rng = np.random.default_rng(13)
+    x = rng.integers(0, 1 << 20, size=(6, dim)).astype(np.uint32)
+    out = single_chip_round_pallas(
+        s, FullMasking(p), tile=128, interpret=True, external_bits_fn=ext,
+        dim_tile=96,
+    )(jnp.asarray(x), jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(
+        np.asarray(out), x.astype(np.int64).sum(axis=0) % p)
+
+
 @pytest.mark.parametrize("P", [1, 2])
 def test_single_participant_edge(P):
     """P=1/P=2 rounds: the smallest participant counts exercise pb-clamp

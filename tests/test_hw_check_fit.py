@@ -1,0 +1,63 @@
+"""benchmarks/hw_check.py affine_fit_report — the timing_check v2 math.
+
+The fit runs only inside scarce hardware windows, so its classification
+logic is pinned here off-chip: a fit bug must not burn a TPU window (the
+round-3 window shipped an unexplained ok:false exactly because the old
+two-point probe had no model behind it).
+"""
+
+import importlib.util
+import os
+
+_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks", "hw_check.py")
+_spec = importlib.util.spec_from_file_location("bench_hw_check", _PATH)
+hw_check = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(hw_check)
+
+
+def test_linear_scaling_classified_linear():
+    # t = 1ms + 25ns/dim: tiny intercept, flat per-element cost
+    pts = [(dd, 1e-3 + 25e-9 * dd)
+           for dd in (250_000, 500_000, 750_000, 1_000_000)]
+    r = hw_check.affine_fit_report(pts, participants=100)
+    assert r["ok"] is True
+    assert r["classification"] == "linear"
+    assert abs(r["model"]["ns_per_dim"] - 25.0) < 0.1
+    assert r["ratio_full_half"] is not None
+
+def test_round3_superlinear_signature_detected():
+    # the measured round-3 shape: per-element cost ~1.7x worse at full
+    # width than at half (25.83ms@1M vs 7.67ms@0.5M), quadratic-ish tail
+    pts = [(250_008, 3.2e-3), (499_992, 7.67e-3),
+           (750_000, 15.0e-3), (999_999, 25.83e-3)]
+    r = hw_check.affine_fit_report(pts, participants=100)
+    assert r["classification"] == "superlinear"
+    assert r["el_cost_ratio_last_vs_first"] > 1.25
+
+
+def test_fixed_overhead_classified_affine_with_overhead():
+    # t = 10ms + 10ns/dim: clean fit, large intercept (per-element cost
+    # FALLS with dim — the opposite of superlinear)
+    pts = [(250_000, 12.5e-3), (500_000, 15e-3),
+           (750_000, 17.5e-3), (1_000_000, 20e-3)]
+    r = hw_check.affine_fit_report(pts, participants=100)
+    assert r["ok"] is True
+    assert r["classification"] == "affine-with-overhead"
+
+
+def test_noisy_measurements_classified_inconsistent():
+    # no affine model fits these within 10%: the under-synchronized-chain
+    # failure mode must be flagged, not averaged away
+    pts = [(250_000, 20e-3), (500_000, 4e-3),
+           (750_000, 30e-3), (1_000_000, 6e-3)]
+    r = hw_check.affine_fit_report(pts, participants=100)
+    assert r["ok"] is False
+    assert r["classification"] == "inconsistent"
+
+
+def test_three_point_fit_has_no_full_half_ratio():
+    pts = [(333_336, 8e-3), (666_672, 16e-3), (999_999, 24e-3)]
+    r = hw_check.affine_fit_report(pts, participants=100)
+    assert r["ratio_full_half"] is None
+    assert r["classification"] == "linear"
