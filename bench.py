@@ -165,12 +165,16 @@ def _run(platform: str, use_pallas: bool) -> dict:
                 dim_tile_knob,
             )
 
-            # the persisted dim_tile verdict comes from a PALLAS-only A/B
-            # (hw_check tiled_ab); on the plain-XLA rung a 0/absent knob
-            # must not disable the schedule that exists to fix the XLA
-            # path's measured superlinearity — default it back on
-            dt = dim_tile_knob() if use_pallas else (
-                dim_tile_knob() or DEFAULT_DIM_TILE)
+            dt = dim_tile_knob()
+            if (dt is None and not use_pallas
+                    and os.environ.get("SDA_PALLAS_DIMTILE_SOURCE")
+                    == "sweep"):
+                # the persisted dim_tile=0 verdict comes from a PALLAS-only
+                # A/B (hw_check tiled_ab); on the plain-XLA rung it must
+                # not disable the schedule that exists to fix the XLA
+                # path's measured superlinearity. An EXPLICIT user
+                # SDA_PALLAS_DIMTILE=0 (no sweep marker) stays disabled.
+                dt = DEFAULT_DIM_TILE
             if dt and dt < dim:
                 if use_pallas:
                     from sda_tpu.fields.pallas_round import (

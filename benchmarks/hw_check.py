@@ -319,9 +319,9 @@ def main() -> int:
                             break
         if best is not None:
             _emit("sweep_best", **best)
-            # persist the winning knobs: pallas_knobs() reads this file in
-            # FRESH processes, so the watch's and the driver's bench.py
-            # runs inherit the tuned values without env plumbing
+            # persist the winning knobs: fresh bench processes (the
+            # driver's bench.py rung children, suite.py) inherit them by
+            # calling export_knobs_to_env at their entry points
             import datetime
 
             knobs_path = os.path.join(

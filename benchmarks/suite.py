@@ -645,9 +645,13 @@ def _stamp_stale(merged: dict) -> None:
 
     def ts(r):
         try:
-            return datetime.datetime.fromisoformat(r["recorded_at"])
+            t = datetime.datetime.fromisoformat(r["recorded_at"])
         except (KeyError, TypeError, ValueError):
             return None
+        if t.tzinfo is None:  # hand-edited naive stamp: assume UTC so the
+            # max()/subtraction below never mixes naive and aware
+            t = t.replace(tzinfo=datetime.timezone.utc)
+        return t
     stamps = {c: ts(r) for c, r in merged.items()}
     newest = max((t for t in stamps.values() if t is not None), default=None)
     for c, r in merged.items():

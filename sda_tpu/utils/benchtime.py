@@ -97,8 +97,10 @@ def pallas_knobs():
 
     Env-only by design: library runtime behavior must not depend on the
     mutable committed sweep artifact (benchmarks/PALLAS_KNOBS.json). The
-    bench entry points (bench.py, benchmarks/suite.py, hw_check) opt in
-    to the file record via ``export_knobs_to_env`` before running.
+    bench entry points (bench.py rung children, benchmarks/suite.py) opt
+    in to the file record via ``export_knobs_to_env`` before running;
+    hw_check derives knobs from its own on-chip sweep and exports them
+    to the same env vars.
     """
     import os
 
@@ -136,7 +138,11 @@ def export_knobs_to_env() -> dict:
     if isinstance(rec.get("stream_pc"), int):
         os.environ.setdefault("SDA_BENCH_STREAM_PC", str(rec["stream_pc"]))
     if isinstance(rec.get("dim_tile"), int):
-        os.environ.setdefault("SDA_PALLAS_DIMTILE", str(rec["dim_tile"]))
+        if "SDA_PALLAS_DIMTILE" not in os.environ:
+            os.environ["SDA_PALLAS_DIMTILE"] = str(rec["dim_tile"])
+            # marked so a record verdict (measured on the pallas A/B only)
+            # can be told apart from an explicit user disable
+            os.environ["SDA_PALLAS_DIMTILE_SOURCE"] = "sweep"
     return rec
 
 

@@ -103,3 +103,17 @@ def test_merge_cpu_rerun_never_downgrades_tpu_record(tmp_path):
     by = {r["config"]: r for r in data["results"]}
     assert by["packed-1m"]["stale"] is True
     assert "stale" not in by["paillier-2048"]
+
+
+def test_merge_tolerates_naive_timestamps(tmp_path):
+    # a hand-edited record without a timezone must not crash the merge
+    # (it runs after every config inside a scarce hardware window)
+    data = _merge(tmp_path, [
+        {"config": "packed-1m", "value": 1.0, "platform": "tpu",
+         "recorded_at": "2026-07-28T10:00:00"},
+        {"config": "lenet-60k", "value": 2.0, "platform": "tpu",
+         "recorded_at": "2026-07-30T15:40:00+00:00"},
+    ])
+    by = {r["config"]: r for r in data["results"]}
+    assert by["packed-1m"]["stale"] is True
+    assert "stale" not in by["lenet-60k"]

@@ -4,6 +4,8 @@ Validates that the one-program SPMD round (psum_scatter transpose+combine,
 all_gather reconstruct) computes exactly what the protocol stack computes.
 """
 
+import os
+
 import jax
 import numpy as np
 import pytest
@@ -292,3 +294,53 @@ def test_multislice_mesh_pod_and_streamed_exact():
         np.asarray(streamed.aggregate(inputs, key=jax.random.PRNGKey(1))),
         inputs.sum(axis=0) % 433,
     )
+
+
+@pytest.mark.parametrize("n_devices,shapes", [
+    (16, ((8, 2), (4, 4), (2, 8))),
+    (32, ((8, 4), (4, 8))),
+])
+def test_wide_virtual_mesh_rounds_subprocess(n_devices, shapes):
+    """16- and 32-device meshes (beyond the suite's 8 virtual devices):
+    packed + BasicShamir quorum rounds on several (p, d) factorizations.
+    Runs in a subprocess because the virtual device count is fixed at
+    backend init (round-3 verdict #6: the 8x1 shape can't catch the
+    divisibility/sharding bugs wider meshes and d-heavy shards can)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    code = textwrap.dedent(f"""
+        from sda_tpu.utils.backend import force_cpu
+        force_cpu({n_devices})
+        import jax
+        import numpy as np
+        from sda_tpu.mesh import SimulatedPod, make_mesh
+        from sda_tpu.protocol import (BasicShamirSharing, FullMasking,
+                                      PackedShamirSharing)
+
+        scheme = PackedShamirSharing(3, 8, 4, 433, 354, 150)
+        basic = BasicShamirSharing(share_count=8, privacy_threshold=3,
+                                   prime_modulus=433)
+        rng = np.random.default_rng(0)
+        for ps, ds in {shapes!r}:
+            mesh = make_mesh(ps, ds)
+            dim = scheme.secret_count * ds * 4
+            x = rng.integers(0, 50, size=(2 * ps + 1, dim))
+            exp = x.sum(axis=0) % 433
+            pod = SimulatedPod(scheme, masking_scheme=FullMasking(433),
+                               mesh=mesh)
+            np.testing.assert_array_equal(
+                np.asarray(pod.aggregate(x, key=jax.random.PRNGKey(1))), exp)
+            bpod = SimulatedPod(basic, masking_scheme=FullMasking(433),
+                                mesh=mesh, surviving_clerks=(1, 3, 5, 7))
+            np.testing.assert_array_equal(
+                np.asarray(bpod.aggregate(x, key=jax.random.PRNGKey(2))), exp)
+            print("OK", ps, ds, flush=True)
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=900,
+                       env={**os.environ, "XLA_FLAGS": ""})
+    assert r.returncode == 0, r.stderr[-2000:]
+    for ps, ds in shapes:
+        assert f"OK {ps} {ds}" in r.stdout
