@@ -95,14 +95,25 @@ def test_merge_cpu_rerun_never_downgrades_tpu_record(tmp_path):
     # the rejected downgrade contributed no newer record, so the surviving
     # TPU evidence is still the newest window: not stale
     assert "stale" not in by["packed-1m"]
-    # once ANOTHER config lands from a later window, the old TPU record is
-    # visibly from an earlier one
+    # a NON-tpu record from a later time must not move the staleness
+    # anchor (windows are TPU events; a CPU dev-box rerun of one config
+    # must not relabel the whole file stale)
     data = _merge(tmp_path, [
-        {"config": "paillier-2048", "value": 9.0, "platform": "host",
+        {"config": "paillier-premix", "value": 9.0, "platform": "cpu",
+         "recorded_at": "2026-07-31T15:00:00+00:00"}])
+    by = {r["config"]: r for r in data["results"]}
+    assert "stale" not in by["packed-1m"]
+    assert "stale" not in by["paillier-premix"]  # newer than the anchor
+    # once another TPU record lands from a later window, the old TPU
+    # record is visibly from an earlier one
+    data = _merge(tmp_path, [
+        {"config": "lenet-60k", "value": 9.0, "platform": "tpu",
          "recorded_at": "2026-07-31T15:00:00+00:00"}])
     by = {r["config"]: r for r in data["results"]}
     assert by["packed-1m"]["stale"] is True
-    assert "stale" not in by["paillier-2048"]
+    # the cpu record carries the same timestamp as the new anchor: fresh
+    assert "stale" not in by["paillier-premix"]
+    assert "stale" not in by["lenet-60k"]
 
 
 def test_merge_tolerates_naive_timestamps(tmp_path):
