@@ -159,8 +159,16 @@ def paillier_combine(ek: EncryptionKey, scheme: PackedPaillierEncryption,
             f"{pk.bitsize}-bit key below the scheme's "
             f"{scheme.min_modulus_bitsize}-bit floor"
         )
+    import os
+
+    # default host path folds INCREMENTALLY (O(B) working set); only the
+    # opt-in device path batches rows (its users accept the O(P*B) staging
+    # in exchange for the kernel fold)
+    device = os.environ.get("SDA_PREMIX_DEVICE") == "1"
     count: Optional[int] = None
+    batch_len: Optional[int] = None
     total_summands = 0
+    rows: list = []
     acc: list = []
     for e in encryptions:
         if e.variant != "PackedPaillier":
@@ -168,11 +176,17 @@ def paillier_combine(ek: EncryptionKey, scheme: PackedPaillierEncryption,
         n, summands, cs = _unframe_paillier(e.value.data)
         total_summands += summands
         if count is None:
-            count, acc = n, list(cs)
+            count, batch_len = n, len(cs)
+        elif n != count or len(cs) != batch_len:
+            raise ValueError("mismatched batch shapes in homomorphic combine")
+        if device:
+            rows.append(list(cs))
+        elif not acc:
+            acc = list(cs)
         else:
-            if n != count or len(cs) != len(acc):
-                raise ValueError("mismatched batch shapes in homomorphic combine")
             acc = [paillier.add(pk, a, c) for a, c in zip(acc, cs)]
+    if device:
+        acc = _premix_rows(pk, rows)
     # summand counts accumulate through nested combines, so the window-
     # overflow bound holds for the TOTAL number of fresh encryptions folded
     # in, not just this call's operand list
@@ -186,6 +200,87 @@ def paillier_combine(ek: EncryptionKey, scheme: PackedPaillierEncryption,
         raw = c.to_bytes((c.bit_length() + 7) // 8 or 1, "big")
         out.append(_leb128(len(raw)) + raw)
     return Encryption("PackedPaillier", Binary(b"".join(out)))
+
+
+#: device premix engages only when the fold is big enough to amortize the
+#: kernel dispatch (and, once per shape bucket, its compile)
+_DEVICE_PREMIX_MIN_MODMULS = 64
+#: rows per device fold chunk: bounds the [P, B, L] upload block (~23 KB
+#: per row at 2048-bit keys) while keeping each dispatch large
+_DEVICE_PREMIX_CHUNK_ROWS = 512
+
+#: MontgomeryContext per n^2, tiny LRU: a long-lived broker rotates
+#: committee keys, and each context pins compiled kernels — keep only the
+#: few most recent instead of growing forever
+_MONT_CTX_CACHE: "OrderedDict" = None  # type: ignore[assignment]
+_MONT_CTX_CACHE_MAX = 4
+
+
+def _premix_rows(pk, rows: list) -> list:
+    """Fold [P][B] ciphertext ints to [B] products mod n^2 (the device
+    leg of paillier_combine: bit-identical to the host paillier.add fold;
+    the server's premix hot loop scales with P, reference
+    server/src/snapshot.rs:4-47). Rows are chunked
+    (_DEVICE_PREMIX_CHUNK_ROWS bounds every upload block), each chunk
+    padded with ciphertext 1 — the multiplicative identity, so padding
+    never changes the product — to a power-of-two row count that bounds
+    the number of compiled shapes. Folds below the size floor, and any
+    device failure, fall back to the host fold (premixing is an
+    optimization, never a correctness dependency)."""
+    if len(rows) <= 1:
+        return list(rows[0]) if rows else []
+    B = len(rows[0])
+    if len(rows) * B >= _DEVICE_PREMIX_MIN_MODMULS:
+        try:
+            return _device_premix_rows(pk, rows)
+        except Exception as e:  # noqa: BLE001 — optimization, not contract
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "device premix failed (%s: %s); falling back to host fold",
+                type(e).__name__, e)
+    acc = list(rows[0])
+    for cs in rows[1:]:
+        acc = [paillier.add(pk, a, c) for a, c in zip(acc, cs)]
+    return acc
+
+
+def _mont_ctx(modulus):
+    from collections import OrderedDict
+
+    from .paillier_tpu import MontgomeryContext
+
+    global _MONT_CTX_CACHE
+    if _MONT_CTX_CACHE is None:
+        _MONT_CTX_CACHE = OrderedDict()
+    ctx = _MONT_CTX_CACHE.get(modulus)
+    if ctx is None:
+        ctx = _MONT_CTX_CACHE[modulus] = MontgomeryContext(modulus)
+        while len(_MONT_CTX_CACHE) > _MONT_CTX_CACHE_MAX:
+            _MONT_CTX_CACHE.popitem(last=False)
+    else:
+        _MONT_CTX_CACHE.move_to_end(modulus)
+    return ctx
+
+
+def _device_premix_rows(pk, rows: list) -> list:
+    ctx = _mont_ctx(pk.n_squared)
+    B = len(rows[0])
+    # tree reduction: every level folds chunks of at most
+    # _DEVICE_PREMIX_CHUNK_ROWS rows, so no single dispatch (including
+    # the reduction over partial products) exceeds the upload bound
+    while len(rows) > 1:
+        next_rows = []
+        for lo in range(0, len(rows), _DEVICE_PREMIX_CHUNK_ROWS):
+            chunk = rows[lo:lo + _DEVICE_PREMIX_CHUNK_ROWS]
+            if len(chunk) == 1:
+                next_rows.append(chunk[0])
+                continue
+            P = 1 << (len(chunk) - 1).bit_length()  # pow2 bucket
+            chunk = chunk + [[1] * B] * (P - len(chunk))
+            next_rows.append(ctx.premix(chunk))
+        rows = next_rows
+    return rows[0]
 
 
 def _leb128(n: int) -> bytes:

@@ -367,13 +367,23 @@ def test_full_loop_with_paillier_encryption(sharing, masking, recipient_scheme):
 
 
 @pytest.mark.skipif(not sodium.available(), reason="libsodium not present")
+@pytest.mark.parametrize("device", [False, True], ids=["host", "device"])
 @pytest.mark.parametrize("capacity_bits", [16, 1], ids=["one-batch", "chunked"])
-def test_server_premixes_paillier_clerk_columns(capacity_bits):
+def test_server_premixes_paillier_clerk_columns(capacity_bits, device,
+                                                monkeypatch):
     """Opt-in broker premixing: with PackedPaillier committee encryption the
     snapshot combines each clerk's ciphertext column homomorphically, so a
     clerk downloads ceil(N/capacity) batches instead of N — and the round
     stays exact. capacity 2^1 forces the chunked path (5 participants ->
-    3 combined batches)."""
+    3 combined batches). The device variant routes the fold through the
+    limb-Montgomery kernel (folds below the size floor stay on host —
+    the protocol outcome must be identical either way)."""
+    if device:
+        monkeypatch.setenv("SDA_PREMIX_DEVICE", "1")
+        monkeypatch.setattr(
+            "sda_tpu.crypto.encryption._DEVICE_PREMIX_MIN_MODMULS", 1)
+    else:
+        monkeypatch.delenv("SDA_PREMIX_DEVICE", raising=False)
     service = new_memory_server()
     service.server.premix_paillier = True
     scheme = PackedPaillierEncryption(3, 16 + capacity_bits, 16, 512)
@@ -673,3 +683,59 @@ def test_committee_election_filters_by_key_variant():
     # and the dual-keyed agent must be paired with its PAILLIER key id
     assert set(elected) == eligible
     assert elected[third.agent.id] == third_paillier_key
+
+
+def test_combine_device_premix_bit_identical(keypair, monkeypatch):
+    """SDA_PREMIX_DEVICE=1 routes the fold through the batched limb-
+    Montgomery kernel — the framed ciphertext product must be BYTE-
+    identical to the host fold (the clerk-side flow decrypts whatever the
+    broker enqueued; a single differing limb corrupts share sums)."""
+    enc = encryption.new_share_encryptor(keypair.ek, SCHEME)
+    rng = np.random.default_rng(23)
+    vectors = rng.integers(0, 433, size=(9, 24))
+    batches = [enc.encrypt(v) for v in vectors]
+    monkeypatch.delenv("SDA_PREMIX_DEVICE", raising=False)
+    host = paillier_combine(keypair.ek, SCHEME, batches)
+    monkeypatch.setenv("SDA_PREMIX_DEVICE", "1")
+    dev = paillier_combine(keypair.ek, SCHEME, batches)
+    assert dev.value.data == host.value.data
+
+
+def test_combine_device_premix_chunked_partials(keypair, monkeypatch):
+    """Row counts above the chunk bound fold chunk products of products —
+    still byte-identical (identity-ciphertext padding never shows)."""
+    from sda_tpu.crypto import encryption as enc_mod
+
+    enc = encryption.new_share_encryptor(keypair.ek, SCHEME)
+    rng = np.random.default_rng(29)
+    vectors = rng.integers(0, 433, size=(11, 24))
+    batches = [enc.encrypt(v) for v in vectors]
+    host = paillier_combine(keypair.ek, SCHEME, batches)
+    monkeypatch.setenv("SDA_PREMIX_DEVICE", "1")
+    monkeypatch.setattr(enc_mod, "_DEVICE_PREMIX_CHUNK_ROWS", 4)
+    dev = paillier_combine(keypair.ek, SCHEME, batches)
+    assert dev.value.data == host.value.data
+
+
+def test_combine_device_premix_falls_back_on_device_failure(
+        keypair, monkeypatch, caplog):
+    """A broken device path must degrade to the host fold with a warning,
+    never a wrong or missing result (premixing is an optimization)."""
+    import logging
+
+    from sda_tpu.crypto import encryption as enc_mod
+
+    enc = encryption.new_share_encryptor(keypair.ek, SCHEME)
+    vectors = np.arange(9 * 24).reshape(9, 24) % 433
+    batches = [enc.encrypt(v) for v in vectors]
+    host = paillier_combine(keypair.ek, SCHEME, batches)
+    monkeypatch.setenv("SDA_PREMIX_DEVICE", "1")
+
+    def boom(pk, rows):
+        raise RuntimeError("no device")
+
+    monkeypatch.setattr(enc_mod, "_device_premix_rows", boom)
+    with caplog.at_level(logging.WARNING):
+        dev = paillier_combine(keypair.ek, SCHEME, batches)
+    assert dev.value.data == host.value.data
+    assert any("falling back to host fold" in r.message for r in caplog.records)
