@@ -87,14 +87,31 @@ def affine_fit_report(pts, participants: int) -> dict:
 
 
 def main() -> int:
-    if not probe_tpu(
+    # SDA_HW_REHEARSE=1: execute the WHOLE pipeline (same control flow,
+    # same stage order, same record writes to a scratch knob file) on the
+    # CPU backend with scaled-down workloads. The pipeline runs for real
+    # only inside scarce tunnel windows, so every reorder must be
+    # rehearsable off-chip — an untested pipeline bug costs a window.
+    rehearse = os.environ.get("SDA_HW_REHEARSE") == "1"
+    if rehearse:
+        _emit("probe", ok=True, rehearse=True)
+        use_platform("cpu")
+        # pallas kernels need interpret mode on CPU; the suite children
+        # must stay on CPU and small
+        os.environ["SDA_BENCH_PLATFORM"] = "cpu"
+        os.environ.setdefault("SDA_BENCH_CONFIGS", "readme-walkthrough")
+        os.environ.setdefault("SDA_BENCH_SECONDS", "1")
+        os.environ.setdefault("SDA_HW_SUITE_TIMEOUT", "600")
+        os.environ.setdefault("SDA_HW_REFRESH_TIMEOUT", "600")
+    elif not probe_tpu(
         float(os.environ.get("SDA_HW_PROBE_TIMEOUT", 120)),
         attempts=int(os.environ.get("SDA_HW_PROBE_ATTEMPTS", 1)),
     ):
         _emit("probe", ok=False, detail="TPU probe timed out; tunnel down")
         return 1
-    _emit("probe", ok=True)
-    use_platform("axon")
+    else:
+        _emit("probe", ok=True)
+        use_platform("axon")
 
     import jax
     import jax.numpy as jnp
@@ -117,6 +134,24 @@ def main() -> int:
     rng = np.random.default_rng(11)
     ok = True
 
+    if rehearse:
+        # CPU has no TPU PRNG primitive: pallas kernels run in interpret
+        # mode with pre-drawn bits (tests/util.py layout contract), and
+        # the streamed A/B exercises the XLA step only
+        def _ext_bits(bkey, P_, draws, B_):
+            return jax.random.bits(bkey, (P_, 2 * draws, B_),
+                                   dtype=jnp.uint32)
+
+        pallas_kw = {"interpret": True, "external_bits_fn": _ext_bits}
+        import tempfile
+
+        # sweep results from a CPU rehearsal must never touch the
+        # committed hardware knob record
+        os.environ.setdefault("SDA_HW_KNOBS_PATH", os.path.join(
+            tempfile.mkdtemp(prefix="sda_rehearse_"), "knobs.json"))
+    else:
+        pallas_kw = {}
+
     # -- exactness smoke (small shapes, every execution surface) ----------
     # host copies + expected sums computed once, BEFORE any device upload:
     # no D2H refetches over the flaky tunnel
@@ -125,7 +160,8 @@ def main() -> int:
     expected = host_small.astype(np.int64).sum(axis=0) % p
     surfaces = [
         ("xla_round", lambda: jax.jit(single_chip_round(scheme, FullMasking(p)))(small, key)),
-        ("pallas_round", lambda: jax.jit(single_chip_round_pallas(scheme, FullMasking(p)))(small, key)),
+        ("pallas_round", lambda: jax.jit(single_chip_round_pallas(
+            scheme, FullMasking(p), **pallas_kw))(small, key)),
         ("chacha_round", lambda: jax.jit(single_chip_round(scheme, ChaChaMasking(p, 6144, 128)))(small, key)),
         ("pod_1x1", lambda: SimulatedPod(scheme, FullMasking(p), mesh=make_mesh(1, 1)).aggregate(host_small, key=key)),
         ("streaming_chacha", lambda: StreamingAggregator(
@@ -176,17 +212,21 @@ def main() -> int:
     # -- headline timings (marginal method; see utils/benchtime.py) -------
     from sda_tpu.utils.benchtime import DEFAULT_DIM_TILE
 
-    P, d = 100, 999_999
+    P, d = (100, 999_999) if not rehearse else (16, 99_999)
+    # rehearsal scales the tile with the dim so the tiled schedules still
+    # run multi-tile scans (d < tile would shortcut to the untiled body)
+    dim_tile_w = DEFAULT_DIM_TILE if not rehearse else 33_336
     host_big = rng.integers(0, 1 << 20, size=(P, d), dtype=np.uint32)
     expected_big = host_big.astype(np.int64).sum(axis=0) % p
     big = jnp.asarray(host_big)
     fn_xla = jax.jit(single_chip_round(scheme, FullMasking(p)))
     fn_xla_tiled = jax.jit(single_chip_round(
-        scheme, FullMasking(p), dim_tile=DEFAULT_DIM_TILE))
+        scheme, FullMasking(p), dim_tile=dim_tile_w))
     for name, build in [
-        ("pallas", lambda: jax.jit(single_chip_round_pallas(scheme, FullMasking(p)))),
+        ("pallas", lambda: jax.jit(single_chip_round_pallas(
+            scheme, FullMasking(p), **pallas_kw))),
         ("pallas_tiled", lambda: jax.jit(single_chip_round_pallas(
-            scheme, FullMasking(p), dim_tile=DEFAULT_DIM_TILE))),
+            scheme, FullMasking(p), dim_tile=dim_tile_w, **pallas_kw))),
         ("xla", lambda: fn_xla),
         ("xla_tiled", lambda: fn_xla_tiled),
     ]:
@@ -234,7 +274,7 @@ def main() -> int:
         # tiled dims = whole multiples of the tile (1, 2, 3 tiles): zero
         # padding, so the fit sees pure schedule scaling
         ("xla_tiled", fn_xla_tiled,
-         [DEFAULT_DIM_TILE, 2 * DEFAULT_DIM_TILE, d]),
+         [dim_tile_w, 2 * dim_tile_w, d]),
     ]:
         try:
             pts = []
@@ -311,8 +351,8 @@ def main() -> int:
         # then pads ZERO rows, where p_block 16/32/64 pad 12-28% of the
         # participant axis (P_eff 112/128) — the round-3 window's
         # streamed-vs-monolithic gap traced to exactly this padding
-        for p_block in (8, 16, 32, 64, 50, 100):
-            for tile in (1024, 2048, 4096):
+        for p_block in (8, 16, 32, 64, 50, 100) if not rehearse else (8,):
+            for tile in (1024, 2048, 4096) if not rehearse else (1024,):
                 point = {"p_block": p_block, "tile": tile}
                 # one retry per point, but only for tunnel-transient errors
                 # (the remote_compile helper throws sporadic HTTP 500s,
@@ -322,7 +362,8 @@ def main() -> int:
                 for attempt in (0, 1):
                     try:
                         fn = jax.jit(single_chip_round_pallas(
-                            scheme, FullMasking(p), p_block=p_block, tile=tile))
+                            scheme, FullMasking(p), p_block=p_block,
+                            tile=tile, **pallas_kw))
                         out = jax.device_get(fn(big, key))
                         if not np.array_equal(out, expected_big):
                             _emit("sweep", **point, ok=False, error="inexact")
@@ -351,18 +392,27 @@ def main() -> int:
             # calling export_knobs_to_env at their entry points
             import datetime
 
-            knobs_path = os.path.join(
+            knobs_path = os.environ.get("SDA_HW_KNOBS_PATH") or os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
                 "PALLAS_KNOBS.json")
             tmp_path = knobs_path + ".tmp"
+            # MERGE into the committed record: stream_pc/dim_tile from an
+            # earlier window must survive if the tunnel dies before the
+            # tiled/streamed A/B stages below re-measure them
+            try:
+                with open(knobs_path) as kf:
+                    knobs_rec = json.load(kf)
+            except (OSError, ValueError):
+                knobs_rec = {}
+            knobs_rec.update({
+                "p_block": best["p_block"], "tile": best["tile"],
+                "gel_per_sec": best["gel_per_sec"],
+                "swept_at": datetime.datetime.now(
+                    datetime.timezone.utc).isoformat(timespec="seconds"),
+                "workload": f"packed-shamir n=8, {P} x {d}, full mask",
+            })
             with open(tmp_path, "w") as kf:
-                json.dump({
-                    "p_block": best["p_block"], "tile": best["tile"],
-                    "gel_per_sec": best["gel_per_sec"],
-                    "swept_at": datetime.datetime.now(
-                        datetime.timezone.utc).isoformat(timespec="seconds"),
-                    "workload": "packed-shamir n=8, 100 x 999999, full mask",
-                }, kf, indent=2)
+                json.dump(knobs_rec, kf, indent=2)
             os.replace(tmp_path, knobs_path)
             # streamed-step A/B on chip (round-2 verdict #4 'done'
             # criterion): the same device-resident chunk loop with the
@@ -380,7 +430,7 @@ def main() -> int:
             try:
                 fn_t = jax.jit(single_chip_round_pallas(
                     scheme, FullMasking(p), p_block=best["p_block"],
-                    tile=best["tile"], dim_tile=DEFAULT_DIM_TILE))
+                    tile=best["tile"], dim_tile=dim_tile_w, **pallas_kw))
                 out_t = jax.device_get(fn_t(big, key))
                 t_exact = bool(np.array_equal(out_t, expected_big))
                 per_t, _ti = marginal_seconds(
@@ -388,13 +438,13 @@ def main() -> int:
                     target_seconds=4)
                 tiled_rate = round(P * d / per_t / 1e9, 2)
                 tiled_wins = t_exact and tiled_rate > best["gel_per_sec"]
-                _emit("tiled_ab", ok=t_exact, dim_tile=DEFAULT_DIM_TILE,
+                _emit("tiled_ab", ok=t_exact, dim_tile=dim_tile_w,
                       gel_per_sec=tiled_rate,
                       untiled_gel_per_sec=best["gel_per_sec"],
                       winner="tiled" if tiled_wins else "untiled")
                 with open(knobs_path) as kf:
                     rec = json.load(kf)
-                rec["dim_tile"] = DEFAULT_DIM_TILE if tiled_wins else 0
+                rec["dim_tile"] = dim_tile_w if tiled_wins else 0
                 rec["dim_tile_gel_per_sec"] = tiled_rate
                 with open(tmp_path, "w") as kf:
                     json.dump(rec, kf, indent=2)
@@ -410,7 +460,7 @@ def main() -> int:
                     synthetic_device_block_provider32,
                 )
 
-                dc = 3 * (1 << 19)
+                dc = 3 * (1 << 19) if not rehearse else 3 * (1 << 12)
                 ab_exact_dim = 4096  # dims aggregated by the exactness leg
                 prov = synthetic_block_provider32(p, seed=3, max_value=1 << 20)
                 # timing blocks generated ON DEVICE (bit-identical twin
@@ -424,10 +474,13 @@ def main() -> int:
                 # runs ChaCha masking through the pallas step (round-3
                 # addition: wire-PRG mask in the fused XLA pass, kernel
                 # mask-free) — on-chip exactness + cost of the hybrid
-                for use_p, pc, mask_kind in (
-                        (False, 64, "full"), (True, 64, "full"),
-                        (True, 50, "full"), (True, 100, "full"),
-                        (True, 64, "chacha")):
+                ab_points = ((False, 64, "full"), (True, 64, "full"),
+                             (True, 50, "full"), (True, 100, "full"),
+                             (True, 64, "chacha"))
+                if rehearse:  # XLA step only: no interpret plumbing in
+                    # the streaming driver, and CPU pallas can't JIT
+                    ab_points = ((False, 64, "full"), (False, 64, "chacha"))
+                for use_p, pc, mask_kind in ab_points:
                     blocks = [jnp.asarray(
                         prov_dev(i * pc, (i + 1) * pc, 0, dc))
                         for i in range(2)]
@@ -514,10 +567,17 @@ def main() -> int:
                         ("SDA_PALLAS_TILE", "tile"),
                         ("SDA_BENCH_STREAM_PC", "stream_pc"),
                         ("SDA_PALLAS_DIMTILE", "dim_tile")):
+                    src_name = env_name + "_SOURCE"
                     if isinstance(fresh.get(rec_key), int):
                         os.environ[env_name] = str(fresh[rec_key])
-                os.environ["SDA_PALLAS_TILE_SOURCE"] = "sweep"
-                os.environ["SDA_PALLAS_DIMTILE_SOURCE"] = "sweep"
+                        os.environ[src_name] = "sweep"
+                    elif os.environ.get(src_name) == "sweep":
+                        # stale pre-sweep export with no fresh measurement:
+                        # drop it rather than record a never-measured mix
+                        # (an explicit user override — no sweep marker —
+                        # is left untouched)
+                        os.environ.pop(env_name, None)
+                        os.environ.pop(src_name, None)
                 ok = _run_suite(
                     float(os.environ.get("SDA_HW_REFRESH_TIMEOUT", 1200)),
                     "suite_refresh", knobs=fresh,
@@ -536,7 +596,12 @@ def _run_suite(timeout_s: float, label: str, knobs=None,
     whatever finished."""
     import subprocess
 
-    env = dict(os.environ, SDA_BENCH_PLATFORM="tpu", SDA_BENCH_FULL="1")
+    env = dict(os.environ, SDA_BENCH_FULL="1")
+    # real windows FORCE the chip (a stray operator SDA_BENCH_PLATFORM=cpu
+    # export must not waste a scarce window on CPU records); only the
+    # rehearsal pins cpu
+    env["SDA_BENCH_PLATFORM"] = (
+        "cpu" if os.environ.get("SDA_HW_REHEARSE") == "1" else "tpu")
     if configs:
         env["SDA_BENCH_CONFIGS"] = configs
     try:

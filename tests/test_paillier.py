@@ -685,11 +685,15 @@ def test_committee_election_filters_by_key_variant():
     assert elected[third.agent.id] == third_paillier_key
 
 
-def test_combine_device_premix_bit_identical(keypair, monkeypatch):
+def test_combine_device_premix_bit_identical(keypair, monkeypatch, caplog):
     """SDA_PREMIX_DEVICE=1 routes the fold through the batched limb-
     Montgomery kernel — the framed ciphertext product must be BYTE-
     identical to the host fold (the clerk-side flow decrypts whatever the
-    broker enqueued; a single differing limb corrupts share sums)."""
+    broker enqueued; a single differing limb corrupts share sums). A
+    device failure would silently fall back to the host fold and make
+    this comparison vacuous, so the fallback warning is asserted ABSENT."""
+    import logging
+
     enc = encryption.new_share_encryptor(keypair.ek, SCHEME)
     rng = np.random.default_rng(23)
     vectors = rng.integers(0, 433, size=(9, 24))
@@ -697,13 +701,18 @@ def test_combine_device_premix_bit_identical(keypair, monkeypatch):
     monkeypatch.delenv("SDA_PREMIX_DEVICE", raising=False)
     host = paillier_combine(keypair.ek, SCHEME, batches)
     monkeypatch.setenv("SDA_PREMIX_DEVICE", "1")
-    dev = paillier_combine(keypair.ek, SCHEME, batches)
+    with caplog.at_level(logging.WARNING):
+        dev = paillier_combine(keypair.ek, SCHEME, batches)
+    assert not any("falling back to host fold" in r.message
+                   for r in caplog.records), "device kernel never ran"
     assert dev.value.data == host.value.data
 
 
-def test_combine_device_premix_chunked_partials(keypair, monkeypatch):
+def test_combine_device_premix_chunked_partials(keypair, monkeypatch, caplog):
     """Row counts above the chunk bound fold chunk products of products —
     still byte-identical (identity-ciphertext padding never shows)."""
+    import logging
+
     from sda_tpu.crypto import encryption as enc_mod
 
     enc = encryption.new_share_encryptor(keypair.ek, SCHEME)
@@ -713,7 +722,10 @@ def test_combine_device_premix_chunked_partials(keypair, monkeypatch):
     host = paillier_combine(keypair.ek, SCHEME, batches)
     monkeypatch.setenv("SDA_PREMIX_DEVICE", "1")
     monkeypatch.setattr(enc_mod, "_DEVICE_PREMIX_CHUNK_ROWS", 4)
-    dev = paillier_combine(keypair.ek, SCHEME, batches)
+    with caplog.at_level(logging.WARNING):
+        dev = paillier_combine(keypair.ek, SCHEME, batches)
+    assert not any("falling back to host fold" in r.message
+                   for r in caplog.records), "device kernel never ran"
     assert dev.value.data == host.value.data
 
 
