@@ -249,8 +249,14 @@ def _e2e_streamed_run(agg, prov_host, prov_dev, participants_run, dim,
     prov = prov_dev if device_generated else prov_host
     reset_phase_report()
     t0 = _time.perf_counter()
+    # boundary-only snapshots (one per dim tile): the default 16-chunk
+    # cadence would D2H ~23 MB of accumulators through the tunnel every
+    # ~180 ms of flagship compute — up to ~40% overhead inside the very
+    # wall_seconds this record exists to publish. A tunnel death loses at
+    # most one dim tile of work (~2 s) before resume.
     out = agg.aggregate_blocks(prov, participants_run, dim, key,
-                               checkpoint_path=checkpoint_path)
+                               checkpoint_path=checkpoint_path,
+                               checkpoint_every_chunks=0)
     wall = _time.perf_counter() - t0
     # ground truth from the driver itself: a foreign/damaged snapshot is
     # rejected by fingerprint and the run is a genuine full round

@@ -339,3 +339,62 @@ def test_streamed_pod_checkpoint_resume_bit_identical(tmp_path):
     np.testing.assert_array_equal(out, ref)
     assert not os.path.exists(ck)
     assert counting["n"] < 12  # resume skipped folded chunks
+
+
+def test_checkpoint_boundary_only_cadence_resumes_exact(tmp_path):
+    """checkpoint_every_chunks=0 snapshots at dim-tile boundaries only
+    (the flagship e2e cadence — intra-tile snapshots would D2H the
+    accumulators through the tunnel every few hundred ms of compute): a
+    crash mid-tile resumes from the last completed tile and the round
+    stays bit-exact."""
+    import os
+
+    from sda_tpu.mesh import StreamingAggregator, synthetic_block_provider32
+
+    s = fast_scheme()
+    p = s.prime_modulus
+    key = jax.random.PRNGKey(19)
+    prov = synthetic_block_provider32(p, seed=21, max_value=1 << 20)
+    ck = str(tmp_path / "boundary.ckpt.npz")
+
+    def agg():
+        return StreamingAggregator(
+            s, FullMasking(p), participants_chunk=4, dim_chunk=24
+        )
+
+    ref = agg().aggregate_blocks(prov, 23, 100, key)
+
+    calls = {"n": 0}
+
+    def flaky(p0, p1, d0, d1):
+        calls["n"] += 1
+        # 6 participant chunks per dim tile: call 15 is the third chunk
+        # of dim tile 2 — two chunks are already folded into tile 2's
+        # accumulator when the crash lands, but with cadence 0 no
+        # intra-tile snapshot exists, so resume must DISCARD that partial
+        # fold and rebuild tile 2 from its boundary snapshot
+        if calls["n"] == 15:
+            raise RuntimeError("simulated crash")
+        return prov(p0, p1, d0, d1)
+
+    with pytest.raises(RuntimeError):
+        agg().aggregate_blocks(flaky, 23, 100, key, checkpoint_path=ck,
+                               checkpoint_every_chunks=0)
+    assert os.path.exists(ck)  # the completed-tile boundary snapshot
+
+    resumed = agg()
+    resumed_calls = {"n": 0}
+
+    def counting(p0, p1, d0, d1):
+        resumed_calls["n"] += 1
+        return prov(p0, p1, d0, d1)
+
+    out = resumed.aggregate_blocks(counting, 23, 100, key,
+                                   checkpoint_path=ck,
+                                   checkpoint_every_chunks=0)
+    assert resumed.last_resumed
+    np.testing.assert_array_equal(out, ref)
+    assert not os.path.exists(ck)
+    # dim tiles 0 and 1 (12 chunks) restored from the boundary snapshot;
+    # tiles 2..4 re-fed in full — exactly 18 of the 30 chunks
+    assert resumed_calls["n"] == 18
