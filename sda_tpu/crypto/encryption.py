@@ -12,6 +12,7 @@ batches that let shares be summed without decryption (``paillier_combine``).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -212,7 +213,7 @@ _DEVICE_PREMIX_CHUNK_ROWS = 512
 #: MontgomeryContext per n^2, tiny LRU: a long-lived broker rotates
 #: committee keys, and each context pins compiled kernels — keep only the
 #: few most recent instead of growing forever
-_MONT_CTX_CACHE: "OrderedDict" = None  # type: ignore[assignment]
+_MONT_CTX_CACHE: "OrderedDict" = OrderedDict()
 _MONT_CTX_CACHE_MAX = 4
 
 
@@ -246,13 +247,8 @@ def _premix_rows(pk, rows: list) -> list:
 
 
 def _mont_ctx(modulus):
-    from collections import OrderedDict
-
     from .paillier_tpu import MontgomeryContext
 
-    global _MONT_CTX_CACHE
-    if _MONT_CTX_CACHE is None:
-        _MONT_CTX_CACHE = OrderedDict()
     ctx = _MONT_CTX_CACHE.get(modulus)
     if ctx is None:
         ctx = _MONT_CTX_CACHE[modulus] = MontgomeryContext(modulus)
