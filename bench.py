@@ -18,7 +18,7 @@ hang at init — and even after a SUCCESSFUL liveness probe, the *compile* of
 the real benchmark program can hang for many minutes when the chip tunnel
 degrades (observed live in round 2). So every measurement rung (pallas-TPU,
 plain-TPU, CPU) runs in its own KILLABLE subprocess with a bounded timeout
-under an overall deadline (SDA_BENCH_DEADLINE, default 1500s), and the
+under an overall deadline (SDA_BENCH_DEADLINE, default 1100s), and the
 first rung that produces a JSON line wins. On total failure the bench still
 prints exactly ONE JSON line (an honest error record pointing at the
 committed real-chip number). Diagnostics go to stderr.
