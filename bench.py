@@ -43,7 +43,10 @@ from sda_tpu.utils.backend import use_platform
 def _run(platform: str, use_pallas: bool) -> dict:
     import jax
 
+    from sda_tpu.utils.backend import enable_compile_cache
+
     use_platform(platform)
+    enable_compile_cache(platform)  # windows must not re-pay compiles
 
     import jax.numpy as jnp
     import numpy as np

@@ -113,7 +113,16 @@ def main() -> int:
         _emit("probe", ok=True)
         use_platform("axon")
 
+    from sda_tpu.utils.backend import enable_compile_cache
+
+    # next window must not re-pay this one's compiles (no-op in rehearsal)
+    enable_compile_cache("cpu" if rehearse else "axon")
+
     import jax
+
+    # every compile logs a line at START: through the buffered child pipe
+    # this feeds the watch's stall detector during compile-heavy phases
+    jax.config.update("jax_log_compiles", True)
     import jax.numpy as jnp
     import numpy as np
 
@@ -634,29 +643,89 @@ def _json_lines(text: str) -> list:
     return out
 
 
-def _run_group(cmd: list, env: dict, timeout_s: float):
-    """Run ``cmd`` in its own process group; on timeout kill the whole
-    group (children included). Returns (stdout, returncode|None)."""
+def _heartbeat_mtime(patterns) -> float:
+    """Newest mtime (epoch seconds) among the glob patterns, or 0."""
+    import glob
+
+    newest = 0.0
+    for pat in patterns:
+        for path in glob.glob(pat):
+            try:
+                newest = max(newest, os.path.getmtime(path))
+            except OSError:
+                pass
+    return newest
+
+
+def _run_group(cmd: list, env: dict, timeout_s: float,
+               stall_timeout_s: float = 0.0, heartbeats=()):
+    """Run ``cmd`` in its own process group; kill the whole group
+    (children included) on timeout OR on stall. Returns
+    (stdout, returncode|None, kill_reason|None).
+
+    Stall = no new stdout line AND no mtime advance on any ``heartbeats``
+    glob for ``stall_timeout_s`` (0 disables). A tunnel that dies mid-run
+    leaves the child blocked forever inside a device call; round 4's
+    03:45Z window showed that waiting out the full window timeout
+    (2h default) forfeits any LATER window the tunnel might offer, so
+    progress-starved children are culled early. Suite/checkpoint/compile-
+    cache writes all count as progress — sparse-stdout phases (flagship
+    e2e rounds) advance those files every dim tile."""
     import signal
     import subprocess
+    import threading
+    import time as _time
 
     proc = subprocess.Popen(
         cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True, start_new_session=True,
+        text=True, errors="replace", start_new_session=True,
     )
-    try:
-        out, _ = proc.communicate(timeout=timeout_s)
-        return out or "", proc.returncode
-    except subprocess.TimeoutExpired:
+    lines: list = []
+    start_mono = _time.monotonic()
+    last_line_mono = [start_mono]
+
+    def _reader():
+        # a dead reader freezes the progress clock and loses evidence, so
+        # survive anything short of a closed pipe
+        try:
+            for line in proc.stdout:
+                lines.append(line)
+                last_line_mono[0] = _time.monotonic()
+        except (ValueError, OSError):
+            pass
+
+    th = threading.Thread(target=_reader, daemon=True)
+    th.start()
+    kill_reason = None
+    while True:
+        if proc.poll() is not None:
+            break
+        # monotonic for the timeout/stall clocks — an overnight watch must
+        # not kill (or immortalize) a window over an NTP step; wall time
+        # only where it meets file mtimes
+        now_mono = _time.monotonic()
+        if now_mono - start_mono > timeout_s:
+            kill_reason = "timeout"
+            break
+        if stall_timeout_s:
+            line_age = now_mono - last_line_mono[0]
+            hb = _heartbeat_mtime(heartbeats)
+            hb_age = max(0.0, _time.time() - hb) if hb else float("inf")
+            if min(line_age, hb_age) > stall_timeout_s:
+                kill_reason = "stall"
+                break
+        _time.sleep(5)
+    if kill_reason is not None:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
-        try:
-            out, _ = proc.communicate(timeout=30)
-        except subprocess.TimeoutExpired:
-            out = ""
-        return out or "", None
+    th.join(30)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        pass
+    return "".join(lines), (None if kill_reason else proc.returncode), kill_reason
 
 
 def watch(interval_s: float, probe_timeout_s: float, max_hours: float) -> int:
@@ -697,12 +766,25 @@ def watch(interval_s: float, probe_timeout_s: float, max_hours: float) -> int:
             # only the direct child would orphan a hung grandchild that
             # could later overwrite BENCH_SUITE.json from a dead-tunnel run
             env = dict(os.environ, SDA_HW_FULL="1")
-            out, rc = _run_group(
+            heartbeats = (
+                os.path.join(repo, "BENCH_SUITE.json"),
+                os.path.join(here, "PALLAS_KNOBS.json"),
+                os.path.join(here, ".e2e_*.ckpt.npz"),
+                os.path.join(repo, ".jax_compile_cache", "*"),
+            )
+            out, rc, why = _run_group(
                 [sys.executable, os.path.abspath(__file__)], env,
-                float(os.environ.get("SDA_HW_WINDOW_TIMEOUT", 7200)))
+                float(os.environ.get("SDA_HW_WINDOW_TIMEOUT", 7200)),
+                # default must clear the longest single compile on a COLD
+                # cache: nothing (stdout, cache entry, suite record)
+                # advances DURING one compile, only around it — the
+                # jax_log_compiles line fires at compile START
+                stall_timeout_s=float(
+                    os.environ.get("SDA_HW_STALL_TIMEOUT", 900)),
+                heartbeats=heartbeats)
             if rc is None:
                 record({"event": "full_run", "rc": None,
-                        "error": "window timeout; tunnel likely died mid-run",
+                        "error": f"killed ({why}); tunnel likely died mid-run",
                         "stages": _json_lines(out)})
             else:
                 record({"event": "full_run", "rc": rc,
@@ -713,7 +795,7 @@ def watch(interval_s: float, probe_timeout_s: float, max_hours: float) -> int:
             # suite timed out) is exactly when captured evidence matters
             # most — an all-or-nothing gate burned most of round 3's first
             # window
-            bout, brc = _run_group(
+            bout, brc, _why = _run_group(
                 [sys.executable, os.path.join(repo, "bench.py")],
                 dict(os.environ), 1800)
             results = _json_lines(bout)

@@ -1,0 +1,371 @@
+"""Component-isolation probe: where does the fused round's time go?
+
+The round-3 window left the flagship monolithic round at ~1/3 of the
+repo's own ~1.7e10 el/s roofline (`benchmarks/ROOFLINE.md`), with the gap
+attributed — by reading, not measurement — to "Mosaic op overheads on
+short-sublane tiles and the PRNG". This probe replaces that guess with
+numbers: it times stripped-down variants of the fused Pallas kernel
+(`sda_tpu/fields/pallas_round.py`) that each exercise ONE component of
+the round, on the same grid/tiling/accumulator structure:
+
+    fold_only   — read x tiles + participant fold (HBM read + VPU adds)
+    prng_only   — per-participant mask/randomness draws + fold (no x)
+    no_matmul   — fold + draws (full round minus the share contraction)
+    full        — fold + draws + per-block share matmul (== library path)
+
+Each variant pays the grid/init/loop overhead O once, so the system
+solves exactly: matmul = full - no_matmul, prng = no_matmul - fold_only,
+overhead = prng_only - prng, fold = fold_only - overhead. Two XLA-level fold experiments ride along:
+
+    xla_fold    — modsum32 over the participant axis (the VPU baseline)
+    mxu_fold    — base-128 limb decomposition + int8 dot_general with a
+                  ones vector (preferred_element_type=int32): does the
+                  MXU, idle in this integer workload by construction,
+                  have an exact path into the participant fold?
+
+All mod-p variants are exact (uint32 Solinas algebra from
+fields/fastfield.py); `mxu_fold` is checked bit-exact against `xla_fold`
+before timing, and `full` is checked against the library kernel on-chip
+(same seed + draw order => identical PRNG streams). Usage:
+
+    python benchmarks/kernel_probe.py              # time on the chip
+    SDA_PROBE_INTERPRET=1 python benchmarks/kernel_probe.py
+        # CPU rehearsal: shape/plumbing + fold/mxu exactness only (the
+        # TPU PRNG primitive does not exist off-chip)
+
+Prints one JSON line per stage; the ROOFLINE.md component budget is
+transcribed from this output. Reference semantics under test: the
+mask/share/combine hot loops of client/src/crypto/ (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from sda_tpu.utils.backend import select_platform, use_platform
+
+
+def _emit(stage: str, **kw) -> None:
+    print(json.dumps({"stage": stage, **kw}), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parametric probe kernel (mirrors fused_mask_share_combine's structure)
+
+def probe_call(x_cols, seed, sp, m_host, t, *, do_x, do_prng, do_matmul,
+               tile, p_block, p_tile, interpret=False):
+    """Variant of the fused kernel running only the selected components.
+
+    Same grid (dim tiles x participant tiles), same fold/accumulate
+    structure, same uint32 Solinas algebra as
+    pallas_round.fused_mask_share_combine — so component timings subtract
+    cleanly. Output is always [n, B]; variants without the matmul write
+    their [k, B] fold into the first k rows.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from sda_tpu.fields import fastfield
+    from sda_tpu.fields.fastfield import canon32, modadd32
+
+    _U32 = jnp.uint32
+    P, k, B = x_cols.shape
+    n, m2 = m_host.shape
+    pb = int(p_block)
+    assert P % p_tile == 0 and p_tile % pb == 0 and B % tile == 0
+
+    m_active = np.asarray(m_host)[:, 1:] % sp.p
+    mh_np = (m_active >> 15).astype(np.uint32)
+    ml_np = (m_active & 0x7FFF).astype(np.uint32)
+    n_ptiles = P // p_tile
+
+    def kernel(seed_ref, x_ref, mh_ref, ml_ref, out_ref):
+        if do_prng:
+            pltpu.prng_seed(
+                seed_ref[0],
+                pl.program_id(0) * jnp.int32(n_ptiles) + pl.program_id(1))
+        fan = max(1, 0xFFFFFFFF // (sp.p - 1))
+
+        def fold_slices(get, count):
+            acc, partial, cnt = None, None, 0
+            for i in range(count):
+                sl = get(i)
+                partial = sl if partial is None else partial + sl
+                cnt += 1
+                if cnt == fan or i == count - 1:
+                    pc = canon32(partial, sp)
+                    acc = pc if acc is None else modadd32(acc, pc, sp)
+                    partial, cnt = None, 0
+            return acc
+
+        def draw_sum(rows):
+            bits = pltpu.bitcast(
+                pltpu.prng_random_bits((2 * pb * rows, tile)), _U32)
+            hi = bits[: pb * rows, :]
+            lo = bits[pb * rows:, :]
+            r32 = (1 << 32) % sp.p
+            res = modadd32(
+                fastfield.mulmod32_const(canon32(hi, sp), r32, sp),
+                canon32(lo, sp), sp)
+            return fold_slices(lambda i: res[i * rows: (i + 1) * rows, :], pb)
+
+        mh_k, mh_t = mh_ref[...][:, :k], mh_ref[...][:, k:]
+        ml_k, ml_t = ml_ref[...][:, :k], ml_ref[...][:, k:]
+
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        def body(b_ix, carry):
+            p0 = b_ix * np.int32(pb)
+            values = None
+            if do_x:
+                x_blk = x_ref[pl.ds(p0, pb)]
+                values = fold_slices(lambda i: canon32(x_blk[i], sp), pb)
+            if do_prng:
+                msum = draw_sum(k)
+                values = msum if values is None else modadd32(
+                    values, msum, sp)
+                randsum = draw_sum(t)
+            else:
+                # matmul-without-prng variants contract the values fold
+                # again on the randomness columns: representative load,
+                # no PRNG dependency
+                reps = -(-t // k)
+                randsum = jnp.concatenate([values] * reps, axis=0)[:t, :]
+            if do_matmul:
+                contrib = modadd32(
+                    fastfield.modmatmul32_limbs(mh_k, ml_k, values, sp),
+                    fastfield.modmatmul32_limbs(mh_t, ml_t, randsum, sp),
+                    sp)                                        # [n, TB]
+                out_ref[...] = modadd32(out_ref[...], contrib, sp)
+            else:
+                out_ref[0:k, :] = modadd32(out_ref[0:k, :], values, sp)
+            return carry
+
+        jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(p_tile // pb), body, jnp.int32(0))
+
+    grid = (B // tile, n_ptiles)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((p_tile, k, tile), lambda i, j: (j, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(mh_np.shape, lambda i, j: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(ml_np.shape, lambda i, j: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    call = pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs,
+        out_specs=pl.BlockSpec((n, tile), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, B), _U32),
+        interpret=interpret,
+    )
+    with jax.enable_x64(False):
+        return call(jnp.asarray([seed], jnp.int32), x_cols,
+                    jnp.asarray(mh_np), jnp.asarray(ml_np))
+
+
+# ---------------------------------------------------------------------------
+# XLA-level fold experiments
+
+def xla_fold(x, sp):
+    """modsum32 over the participant axis — the VPU fold baseline."""
+    from sda_tpu.fields.fastfield import modsum32
+
+    return modsum32(x, sp, axis=0)
+
+
+N_LIMBS = 5  # ceil(29 bits / 7) — base-128 keeps limbs in int8's [0,127]
+
+
+def mxu_fold(x, sp):
+    """Participant fold as an int8 ones-vector matmul (exact, mod p).
+
+    x: [P, d] canonical uint32 residues (< p < 2^29). Decompose into
+    base-128 limbs (int8-safe), contract the participant axis on the MXU
+    via dot_general with preferred_element_type=int32 (limb column sums
+    <= P*127 stay well inside int32), then recombine Σ_i s_i·128^i mod p
+    on the VPU. Bit-exact vs xla_fold by construction; whether it is
+    FASTER is what the probe measures — int32 VPU folds leave the MXU
+    idle, and quantized-inference int8 paths may rescue it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sda_tpu.fields.fastfield import canon32, modadd32, mulmod32_const
+
+    P, d = x.shape
+    if P * 127 >= (1 << 31):
+        raise ValueError("participant axis too large for int32 limb sums")
+    shifts = np.arange(N_LIMBS, dtype=np.uint32) * 7
+    limbs = ((x[:, :, None] >> shifts[None, None, :]) & np.uint32(0x7F)
+             ).astype(jnp.int8)                                # [P, d, L]
+    ones = jnp.ones((1, P), jnp.int8)
+    sums = jax.lax.dot_general(
+        ones, limbs.reshape(P, d * N_LIMBS),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).reshape(d, N_LIMBS)                                      # [d, L]
+    acc = None
+    for i in range(N_LIMBS):
+        term = mulmod32_const(
+            canon32(sums[:, i].astype(jnp.uint32), sp),
+            (1 << (7 * i)) % sp.p, sp)
+        acc = term if acc is None else modadd32(acc, term, sp)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+
+def main() -> int:
+    interpret = os.environ.get("SDA_PROBE_INTERPRET") == "1"
+    plat = "cpu" if interpret else select_platform("SDA_PROBE_PLATFORM")
+    use_platform(plat)
+    if plat != "cpu":
+        from sda_tpu.utils.backend import enable_compile_cache
+
+        enable_compile_cache(plat)
+
+    import jax
+    import jax.numpy as jnp
+
+    from sda_tpu.fields import fastfield, numtheory
+    from sda_tpu.fields.pallas_round import fused_mask_share_combine
+    from sda_tpu.protocol import PackedShamirSharing
+    from sda_tpu.utils.benchtime import (
+        export_knobs_to_env,
+        marginal_seconds,
+        pallas_knobs,
+    )
+
+    export_knobs_to_env()  # probe at the committed swept knobs, not defaults
+
+    platform = jax.devices()[0].platform
+    _emit("probe_env", platform=platform, interpret=interpret)
+
+    t_, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    s = PackedShamirSharing(3, 8, t_, p, w2, w3)
+    sp = fastfield.SolinasPrime.try_from(p)
+    m_host = numtheory.share_matrix_for(s)
+    k, t, n = s.secret_count, s.privacy_threshold, s.share_count
+
+    p_block, tile_env = pallas_knobs()
+    tile = tile_env or 2048
+    P = 128 if not interpret else 16
+    ntile = 54 if not interpret else 3
+    B = ntile * tile
+    d = k * B
+    p_tile = P  # one participant tile: probes measure compute, not VMEM
+    rng = np.random.default_rng(7)
+    x_host = rng.integers(0, sp.p, size=(P, k, B), dtype=np.uint32)
+    x_cols = jnp.asarray(x_host)
+    elements = P * d
+
+    # -- exactness gates before any timing --------------------------------
+    x_flat = jnp.asarray(
+        rng.integers(0, sp.p, size=(P, 4096), dtype=np.uint32))
+    ref_fold = jax.device_get(xla_fold(x_flat, sp))
+    got_mxu = jax.device_get(jax.jit(mxu_fold, static_argnums=1)(x_flat, sp))
+    mxu_exact = bool(np.array_equal(ref_fold, got_mxu))
+    _emit("mxu_exact", ok=mxu_exact)
+    if not mxu_exact:
+        return 1
+
+    # jit wrapper exactly as the timed loop builds it, so the rehearsal
+    # exercises the same call shape the chip will run
+    fold_jit = jax.jit(functools.partial(
+        probe_call, sp=sp, m_host=m_host, t=t, do_x=True, do_prng=False,
+        do_matmul=False, tile=tile, p_block=min(p_block, P), p_tile=p_tile,
+        interpret=interpret))
+    fold_ref = jax.device_get(fold_jit(x_cols, 1))
+    exp = (x_host.astype(np.int64).sum(axis=0) % sp.p).astype(np.uint32)
+    fold_exact = bool(np.array_equal(fold_ref[:k], exp))
+    _emit("fold_exact", ok=fold_exact)
+    if not fold_exact:
+        return 1
+
+    ok = True
+    if not interpret:
+        # full variant must match the library kernel bit-for-bit: same
+        # seed, same grid, same draw order => identical PRNG streams
+        lib_shares, _ = fused_mask_share_combine(
+            x_cols, 3, sp, m_host, t, True, tile=tile,
+            p_block=min(p_block, P), p_tile=p_tile)
+        got_full = probe_call(
+            x_cols, 3, sp, m_host, t, do_x=True, do_prng=True,
+            do_matmul=True, tile=tile, p_block=min(p_block, P),
+            p_tile=p_tile)
+        full_exact = bool(np.array_equal(
+            jax.device_get(lib_shares), jax.device_get(got_full)))
+        _emit("full_matches_library", ok=full_exact)
+        ok = ok and full_exact
+
+        variants = [
+            ("fold_only", dict(do_x=True, do_prng=False, do_matmul=False)),
+            ("prng_only", dict(do_x=False, do_prng=True, do_matmul=False)),
+            ("no_matmul", dict(do_x=True, do_prng=True, do_matmul=False)),
+            ("full", dict(do_x=True, do_prng=True, do_matmul=True)),
+        ]
+        secs = {}
+        for name, flags in variants:
+            # jit ONCE per variant: eager probe_call would re-trace every
+            # dispatch, and that host cost differs per variant — it would
+            # leak into the component subtraction as fake device time
+            jitted = jax.jit(functools.partial(
+                probe_call, sp=sp, m_host=m_host, t=t, tile=tile,
+                p_block=min(p_block, P), p_tile=p_tile, **flags))
+
+            def dispatch(i, jitted=jitted):
+                return jitted(x_cols, 100 + i)
+
+            per, info = marginal_seconds(dispatch, target_seconds=4)
+            secs[name] = per
+            _emit("component", name=name, ms=round(per * 1e3, 3),
+                  el_per_s=round(elements / per, 1), **flags)
+        # every variant pays the grid/init/loop overhead O once:
+        #   fold_only = O+F, prng_only = O+R, no_matmul = O+F+R,
+        #   full = O+F+R+M  =>  solve for the four components
+        matmul_s = secs["full"] - secs["no_matmul"]
+        prng_s = secs["no_matmul"] - secs["fold_only"]
+        overhead_s = secs["prng_only"] - prng_s
+        fold_s = secs["fold_only"] - overhead_s
+        _emit("budget",
+              fold_ms=round(fold_s * 1e3, 3),
+              prng_ms=round(prng_s * 1e3, 3),
+              matmul_ms=round(matmul_s * 1e3, 3),
+              overhead_ms=round(overhead_s * 1e3, 3),
+              full_ms=round(secs["full"] * 1e3, 3),
+              full_el_per_s=round(elements / secs["full"], 1))
+
+        # XLA-level fold A/B at the same [P, d] workload
+        x_fold = jnp.asarray(
+            rng.integers(0, sp.p, size=(P, d), dtype=np.uint32))
+        for name, fn in [("xla_fold", xla_fold), ("mxu_fold", mxu_fold)]:
+            jfn = jax.jit(functools.partial(fn, sp=sp))
+
+            def dispatch(i, jfn=jfn):
+                return jfn(x_fold)  # no per-rep variation: pure fold cost
+
+            per, _ = marginal_seconds(dispatch, target_seconds=4)
+            _emit("fold_ab", name=name, ms=round(per * 1e3, 3),
+                  el_per_s=round(elements / per, 1))
+
+    _emit("probe_done", ok=ok)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

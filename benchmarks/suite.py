@@ -665,14 +665,22 @@ CONFIGS = {
 
 
 def main():
-    from sda_tpu.utils.backend import select_platform, use_platform
+    from sda_tpu.utils.backend import (
+        enable_compile_cache,
+        select_platform,
+        use_platform,
+    )
     from sda_tpu.utils.benchtime import export_knobs_to_env
 
     export_knobs_to_env()  # bench entry point opts in to the sweep record
 
     platform = select_platform()
     use_platform(platform)
+    enable_compile_cache(platform)  # short windows must not re-pay compiles
     import jax
+
+    # compile-start lines feed the watch's stall detector (hw_check)
+    jax.config.update("jax_log_compiles", True)
 
     dev = jax.devices()[0]
     meta = {

@@ -81,6 +81,50 @@ def use_platform(platform: str) -> None:
     jax.config.update("jax_platforms", platform)
 
 
+def enable_compile_cache(platform: str = "axon",
+                         path: str | None = None) -> str | None:
+    """Persistent XLA compilation cache for the bench entry points.
+
+    Hardware windows through the axon tunnel can be minutes long (the
+    2026-07-31 03:45Z window died ~4 min in, with most of it spent
+    compiling the flagship step); a persistent cache lets the NEXT window
+    skip straight to the timed sections. Opt-in from bench/hw_check/suite
+    /probe entry points only — library/test runs must not grow an
+    on-disk cache dependency. Returns the cache dir, or None when caching
+    is skipped/unsupported. Call BEFORE the first jit.
+
+    CPU runs are excluded: XLA:CPU's AOT loader warns about machine-
+    feature mismatches with a SIGILL caveat when reloading cached
+    executables (observed in this image), and CPU compiles are seconds,
+    not scarce-window minutes — not worth any crash risk in a fallback
+    rung.
+    """
+    import jax
+
+    if platform == "cpu":
+        return None
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            ".jax_compile_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every entry: through the tunnel even "fast" compiles cost
+        # a scarce-window round-trip
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except (AttributeError, ValueError, OSError) as e:
+        log(f"compile cache unavailable: {type(e).__name__}: {e}")
+        return None
+    try:  # newer knob; cache autotuning etc. too when present
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except (AttributeError, ValueError):
+        pass
+    return path
+
+
 def force_cpu(n_devices: int = 1) -> None:
     """CPU backend with >= n_devices virtual devices, for mesh tests."""
     import re

@@ -1,0 +1,90 @@
+"""The watch's process-group runner: timeout, stall culling, heartbeats.
+
+A tunnel that dies mid-window leaves the hw_check child blocked forever
+inside a device call; benchmarks/hw_check.py's ``_run_group`` must cull
+such children on output/heartbeat starvation instead of waiting out the
+multi-hour window timeout (round-4 03:45Z window postmortem). The runner
+is pure host logic, so the contract is pinned off-chip.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+from hw_check import _heartbeat_mtime, _run_group  # noqa: E402
+
+
+def _py(code: str) -> list:
+    return [sys.executable, "-u", "-c", code]
+
+
+def test_completed_child_passes_through_rc_and_output():
+    out, rc, why = _run_group(
+        _py("print('hello'); raise SystemExit(7)"), dict(os.environ), 30)
+    assert rc == 7
+    assert why is None
+    assert "hello" in out
+
+
+def test_stalled_child_is_killed_with_stall_reason():
+    t0 = time.time()
+    out, rc, why = _run_group(
+        _py("print('started', flush=True)\nimport time; time.sleep(600)"),
+        dict(os.environ), timeout_s=600, stall_timeout_s=8)
+    assert rc is None
+    assert why == "stall"
+    assert "started" in out  # output up to the kill is preserved
+    assert time.time() - t0 < 120  # culled promptly, not at timeout_s
+
+
+def test_steady_output_is_progress():
+    # the child OUTLIVES stall_timeout_s by 3x: only the line-by-line
+    # progress tracking can keep it alive, so deleting that logic (e.g.
+    # progress = start time) fails this test instead of a hardware window
+    code = ("import time\n"
+            "for i in range(8):\n"
+            "    print(i, flush=True)\n"
+            "    time.sleep(3)\n")
+    out, rc, why = _run_group(
+        _py(code), dict(os.environ), timeout_s=600, stall_timeout_s=8)
+    assert rc == 0 and why is None
+    assert "7" in out
+
+
+def test_heartbeat_file_counts_as_progress(tmp_path):
+    hb = tmp_path / "beat.txt"
+    # silent child beating a file for 24s against an 8s stall timeout:
+    # only _heartbeat_mtime progress can carry it to completion
+    code = (f"import time, pathlib\n"
+            f"p = pathlib.Path({str(hb)!r})\n"
+            f"for i in range(8):\n"
+            f"    p.write_text(str(i))\n"
+            f"    time.sleep(3)\n")
+    out, rc, why = _run_group(
+        _py(code), dict(os.environ), timeout_s=600, stall_timeout_s=8,
+        heartbeats=(str(tmp_path / "*.txt"),))
+    assert rc == 0 and why is None
+
+
+def test_timeout_still_kills():
+    out, rc, why = _run_group(
+        _py("import time\n"
+            "while True:\n"
+            "    print('x', flush=True)\n"
+            "    time.sleep(1)\n"),
+        dict(os.environ), timeout_s=8, stall_timeout_s=600)
+    assert rc is None
+    assert why == "timeout"
+
+
+def test_heartbeat_mtime_globs(tmp_path):
+    assert _heartbeat_mtime((str(tmp_path / "*.npz"),)) == 0.0
+    f = tmp_path / "a.npz"
+    f.write_bytes(b"x")
+    got = _heartbeat_mtime((str(tmp_path / "*.npz"),))
+    assert got == pytest.approx(os.path.getmtime(f))
