@@ -594,7 +594,25 @@ def main() -> int:
             else:
                 _emit("suite_refresh", skipped=True,
                       detail="window confirmed the committed knobs")
+    _emit("compile_cache", **_cache_stats())
     return 0 if (ok and suite_ok) else 1
+
+
+def _cache_stats() -> dict:
+    """Entry count/bytes of the persistent compile cache — the observable
+    that tells the NEXT window whether the axon plugin actually serializes
+    executables (if it doesn't, entries stay ~0 and the cache lever is
+    dead; see backend.enable_compile_cache)."""
+    from sda_tpu.utils.backend import compile_cache_dir
+
+    cache_dir = compile_cache_dir()
+    try:
+        names = os.listdir(cache_dir)
+        total = sum(
+            os.path.getsize(os.path.join(cache_dir, f)) for f in names)
+        return {"entries": len(names), "bytes": total}
+    except OSError:
+        return {"entries": 0, "bytes": 0}
 
 
 def _run_suite(timeout_s: float, label: str, knobs=None,
@@ -766,11 +784,13 @@ def watch(interval_s: float, probe_timeout_s: float, max_hours: float) -> int:
             # only the direct child would orphan a hung grandchild that
             # could later overwrite BENCH_SUITE.json from a dead-tunnel run
             env = dict(os.environ, SDA_HW_FULL="1")
+            from sda_tpu.utils.backend import compile_cache_dir
+
             heartbeats = (
                 os.path.join(repo, "BENCH_SUITE.json"),
                 os.path.join(here, "PALLAS_KNOBS.json"),
                 os.path.join(here, ".e2e_*.ckpt.npz"),
-                os.path.join(repo, ".jax_compile_cache", "*"),
+                os.path.join(compile_cache_dir(), "*"),
             )
             out, rc, why = _run_group(
                 [sys.executable, os.path.abspath(__file__)], env,
@@ -801,6 +821,19 @@ def watch(interval_s: float, probe_timeout_s: float, max_hours: float) -> int:
             results = _json_lines(bout)
             result = results[-1] if results else None
             record({"event": "bench", "rc": brc, "result": result})
+            # same window, no operator in the loop: grab the component
+            # budget + MXU fold A/B while the chip still answers (forced
+            # tpu — the stall culling handles a tunnel that died)
+            pout, prc, pwhy = _run_group(
+                [sys.executable, os.path.join(here, "kernel_probe.py")],
+                dict(os.environ, SDA_PROBE_PLATFORM="tpu"), 900,
+                # the probe's kernels are its own shapes (cold on a first
+                # window); one compile must not trip the cull
+                stall_timeout_s=450,
+                heartbeats=(os.path.join(compile_cache_dir(), "*"),))
+            record({"event": "kernel_probe", "rc": prc,
+                    **({"killed": pwhy} if pwhy else {}),
+                    "stages": _json_lines(pout)})
             if (brc == 0 and result and result.get("platform") == "tpu"
                     and rc == 0):
                 record({"event": "watch_done", "ok": True})

@@ -81,6 +81,16 @@ def use_platform(platform: str) -> None:
     jax.config.update("jax_platforms", platform)
 
 
+def compile_cache_dir() -> str:
+    """The one place the persistent-cache location is derived (repo-root
+    /.jax_compile_cache); enable_compile_cache, the watch heartbeats and
+    hw_check's cache-stats observable must all agree on it."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        ".jax_compile_cache")
+
+
 def enable_compile_cache(platform: str = "axon",
                          path: str | None = None) -> str | None:
     """Persistent XLA compilation cache for the bench entry points.
@@ -104,10 +114,7 @@ def enable_compile_cache(platform: str = "axon",
     if platform == "cpu":
         return None
     if path is None:
-        path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))),
-            ".jax_compile_cache")
+        path = compile_cache_dir()
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
