@@ -823,17 +823,24 @@ def watch(interval_s: float, probe_timeout_s: float, max_hours: float) -> int:
             record({"event": "bench", "rc": brc, "result": result})
             # same window, no operator in the loop: grab the component
             # budget + MXU fold A/B while the chip still answers (forced
-            # tpu — the stall culling handles a tunnel that died)
-            pout, prc, pwhy = _run_group(
-                [sys.executable, os.path.join(here, "kernel_probe.py")],
-                dict(os.environ, SDA_PROBE_PLATFORM="tpu"), 900,
-                # the probe's kernels are its own shapes (cold on a first
-                # window); one compile must not trip the cull
-                stall_timeout_s=450,
-                heartbeats=(os.path.join(compile_cache_dir(), "*"),))
-            record({"event": "kernel_probe", "rc": prc,
-                    **({"killed": pwhy} if pwhy else {}),
-                    "stages": _json_lines(pout)})
+            # tpu — the stall culling handles a tunnel that died). One
+            # retry on a cull: the probe's kernels are its own shapes
+            # (cold on a first window), and with the compile cache the
+            # second attempt skips whatever the first one compiled
+            for attempt in (1, 2):
+                pout, prc, pwhy = _run_group(
+                    [sys.executable, os.path.join(here, "kernel_probe.py")],
+                    dict(os.environ, SDA_PROBE_PLATFORM="tpu"),
+                    float(os.environ.get("SDA_HW_PROBE_RUN_TIMEOUT", 900)),
+                    stall_timeout_s=float(
+                        os.environ.get("SDA_HW_PROBE_STALL_TIMEOUT", 450)),
+                    heartbeats=(os.path.join(compile_cache_dir(), "*"),))
+                record({"event": "kernel_probe", "rc": prc,
+                        "attempt": attempt,
+                        **({"killed": pwhy} if pwhy else {}),
+                        "stages": _json_lines(pout)})
+                if pwhy is None:
+                    break
             if (brc == 0 and result and result.get("platform") == "tpu"
                     and rc == 0):
                 record({"event": "watch_done", "ok": True})

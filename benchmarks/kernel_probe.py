@@ -271,7 +271,6 @@ def main() -> int:
     export_knobs_to_env()  # probe at the committed swept knobs, not defaults
 
     platform = jax.devices()[0].platform
-    _emit("probe_env", platform=platform, interpret=interpret)
 
     t_, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
     s = PackedShamirSharing(3, 8, t_, p, w2, w3)
@@ -294,6 +293,11 @@ def main() -> int:
     B = ntile * tile
     d = k * B
     p_tile = P  # one participant tile: probes measure compute, not VMEM
+    # the EFFECTIVE workload/knobs, which may differ from the committed
+    # sweep record (tile halves under the VMEM cap; P follows p_block):
+    # the ROOFLINE transcription must see what was actually probed
+    _emit("probe_env", platform=platform, interpret=interpret,
+          p_block=pb, participants=P, tile=tile, batch_cols=B, dim=d)
     rng = np.random.default_rng(7)
     x_host = rng.integers(0, sp.p, size=(P, k, B), dtype=np.uint32)
     x_cols = jnp.asarray(x_host)

@@ -1,0 +1,65 @@
+"""Contract of the persistent-compile-cache helpers (utils/backend.py).
+
+The cache is the short-window survival lever (a tunnel window must not
+re-pay a previous window's compiles), so its gating — never on CPU,
+shared dir derivation, graceful degradation — is pinned off-chip.
+"""
+
+import os
+
+from sda_tpu.utils.backend import compile_cache_dir, enable_compile_cache
+
+
+def test_cpu_platform_is_gated_off(tmp_path):
+    target = tmp_path / "cache"
+    assert enable_compile_cache("cpu", str(target)) is None
+    # gated BEFORE any filesystem effect
+    assert not target.exists()
+
+
+def test_axon_platform_sets_cache_dir(tmp_path):
+    import jax
+
+    target = tmp_path / "cache"
+    got = enable_compile_cache("axon", str(target))
+    try:
+        assert got == str(target)
+        assert target.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+        # every entry cached: through the tunnel even fast compiles cost
+        # a scarce-window round-trip
+        assert jax.config.jax_persistent_cache_min_entry_size_bytes == -1
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_cache_dir_is_repo_root_derived():
+    d = compile_cache_dir()
+    assert os.path.basename(d) == ".jax_compile_cache"
+    # repo root = the directory holding sda_tpu/
+    root = os.path.dirname(d)
+    assert os.path.isdir(os.path.join(root, "sda_tpu"))
+
+
+def test_hw_check_cache_stats_uses_shared_dir(tmp_path, monkeypatch):
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    import hw_check
+
+    # empty/missing dir reports zeros instead of raising
+    monkeypatch.setattr(
+        "sda_tpu.utils.backend.compile_cache_dir",
+        lambda: str(tmp_path / "nonexistent"))
+    assert hw_check._cache_stats() == {"entries": 0, "bytes": 0}
+
+    d = tmp_path / "cache"
+    d.mkdir()
+    (d / "a").write_bytes(b"xy")
+    (d / "b").write_bytes(b"z")
+    monkeypatch.setattr(
+        "sda_tpu.utils.backend.compile_cache_dir", lambda: str(d))
+    assert hw_check._cache_stats() == {"entries": 2, "bytes": 3}
