@@ -327,9 +327,17 @@ def _streaming_bench(name, participants, dim, max_seconds):
     p = scheme.prime_modulus
     pc = int(os.environ.get("SDA_BENCH_PART_CHUNK", 64))
     # >=1e8-element chunks on TPU amortize dispatch (see ROOFLINE.md on the
-    # round-1 tiny-chunk artifact); CPU uses smaller chunks to fit the budget
+    # round-1 tiny-chunk artifact); CPU uses smaller chunks to fit the
+    # budget. The chunk is sized to DIVIDE the target dim near-evenly so
+    # that with uniform_tail every tile shares one compiled step/finale
+    # shape — in a short tunnel window the tail shapes' extra compiles
+    # cost more than the ~one-tile-in-ntiles padded columns
     dc_cap = 3 * (1 << 19) if not _on_cpu() else 3 * (1 << 15)
-    dc_default = dc_cap if dim > dc_cap else dim
+    if dim > dc_cap:
+        ntiles = -(-dim // dc_cap)
+        dc_default = -(-dim // ntiles)  # aggregator grain-rounds it up
+    else:
+        dc_default = dim
     dc = int(os.environ.get("SDA_BENCH_DIM_CHUNK", dc_default))
     use_pallas = (not _on_cpu()
                   and os.environ.get("SDA_PALLAS", "1") == "1")
@@ -340,7 +348,7 @@ def _streaming_bench(name, participants, dim, max_seconds):
     def build_and_spot_check(with_pallas):
         a = StreamingAggregator(
             scheme, FullMasking(p), participants_chunk=pc, dim_chunk=dc,
-            use_pallas=with_pallas,
+            use_pallas=with_pallas, uniform_tail=True,
         )
         # exactness spot check on a tiny sub-problem before anything is timed
         sub = a.aggregate_blocks(prov_host, 2 * pc, min(dim, 3 * 64), key)
@@ -361,7 +369,12 @@ def _streaming_bench(name, participants, dim, max_seconds):
 
     import jax.numpy as jnp
 
-    dim_covered = min(dim, dc)
+    # steady-state must time the SAME step shape the e2e tiles run: the
+    # aggregator grain-rounds dim_chunk up, and with uniform_tail every
+    # tile is exactly that wide; a single-tile round (dim <= chunk, e.g.
+    # an SDA_BENCH_DIM_CHUNK override) runs grain-rounded dim
+    dim_covered = (agg.dim_chunk if dim > agg.dim_chunk
+                   else -(-dim // agg._grain) * agg._grain)
     s = agg.scheme
     B = -(-dim_covered // s.secret_count)
     acc_dtype = jnp.uint32 if agg._sp is not None else jnp.int64

@@ -329,9 +329,18 @@ def _drive_stream(owner, participants, dimension, key, *, make_block,
     resume_di = int(resume["di"]) if resume is not None else -1
     resume_pi = int(resume["pi"]) if resume is not None else 0
     empty = np.zeros((0,), owner._field.dtype)
+    # uniform_tail: one step/finale shape for every tile — tails on BOTH
+    # axes pad to the full chunk (dc is already grain-rounded); otherwise
+    # the dim tail pads only to the grain and the participant tail keeps
+    # its ragged (separately compiled) shape. Single-tile axes stay at
+    # their natural size — there is no second shape to avoid
+    uniform = bool(getattr(owner, "uniform_tail", False))
+    uniform_d = uniform and dimension > dc
+    uniform_p = uniform and participants > pc
     for di, d0 in enumerate(range(0, dimension, dc)):
         d1 = min(d0 + dc, dimension)
-        d_size = -(-(d1 - d0) // owner._grain) * owner._grain  # pad to grain
+        d_size = dc if uniform_d else (
+            -(-(d1 - d0) // owner._grain) * owner._grain)  # pad to grain
         if resume is not None and di < resume_di:
             continue  # completed tile: out prefix already restored
         if resume is not None and di == resume_di and resume_pi > 0:
@@ -346,6 +355,13 @@ def _drive_stream(owner, participants, dimension, key, *, make_block,
             p1 = min(p0 + pc, participants)
             with timed_phase("stream.feed"):
                 block = make_block(p0, p1, d0, d1, d_size)
+                if uniform_p and block.shape[0] < pc:
+                    # ragged participant tail: zero rows aggregate as
+                    # zero and their masks cancel within the tile, same
+                    # argument as the zero columns
+                    block = jnp.pad(
+                        jnp.asarray(block),
+                        ((0, pc - block.shape[0]), (0, 0)))
             step = owner._steps.get(block.shape)
             if step is None:
                 step = owner._steps[block.shape] = owner._step_fn(block.shape)
@@ -423,6 +439,7 @@ class StreamingAggregator:
         pallas_interpret: bool = False,
         pallas_external_bits_fn=None,
         surviving_clerks=None,
+        uniform_tail: bool = False,
     ):
         self.scheme = s = sharing_scheme
         self.modulus = _scheme_modulus(s)  # also validates the scheme type
@@ -434,6 +451,13 @@ class StreamingAggregator:
         self._grain = _dim_grain(s, self.masking)
         self.participants_chunk = int(participants_chunk)
         self.dim_chunk = -(-int(dim_chunk) // self._grain) * self._grain
+        # uniform_tail pads the LAST dim tile to the full dim_chunk width
+        # (zero columns aggregate as zero; per-tile masks cancel), so every
+        # tile shares ONE compiled step/finale shape — in scarce tunnel
+        # windows the tail shapes' extra compiles cost more than the
+        # padded columns' compute when dim_chunk ~ dim/ntiles. Exactness
+        # pinned in tests/test_streaming.py (uniform-tail block).
+        self.uniform_tail = bool(uniform_tail)
         self.surviving_clerks = _normalize_survivors(s, surviving_clerks)
         self._M_host, self._L_host = _build_matrices(
             s, self.surviving_clerks
@@ -511,6 +535,10 @@ class StreamingAggregator:
             self.scheme, self.masking, participants, dimension,
             self.participants_chunk, self.dim_chunk, self.pallas_active,
             self.surviving_clerks, key,
+            # tail padding changes accumulator shapes mid-round, so a
+            # snapshot must never cross the setting (included only when
+            # set: existing False-mode snapshots keep their fingerprint)
+            extra={"uniform_tail": True} if self.uniform_tail else None,
         )
 
     # back-compat alias for the module-level snapshot loader

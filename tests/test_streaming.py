@@ -398,3 +398,123 @@ def test_checkpoint_boundary_only_cadence_resumes_exact(tmp_path):
     # dim tiles 0 and 1 (12 chunks) restored from the boundary snapshot;
     # tiles 2..4 re-fed in full — exactly 18 of the 30 chunks
     assert resumed_calls["n"] == 18
+
+
+# -- uniform_tail: one compiled step/finale shape per round ----------------
+# Opt-in tail padding (bench entry points use it so scarce hardware windows
+# compile ONE step/finale shape per streamed config instead of paying the
+# ragged-tail shapes' extra compiles).
+
+def test_uniform_tail_exact_and_single_step_shape():
+    scheme = fast_scheme()
+    p = scheme.prime_modulus
+    rng = np.random.default_rng(71)
+    P, d, pc, dc = 9, 100, 4, 36  # tail tile 100-72=28 -> padded to 36
+    x = rng.integers(0, 1 << 16, size=(P, d))
+    expected = x.sum(axis=0) % p
+    for masking in (NoMasking(), FullMasking(p)):
+        agg = StreamingAggregator(
+            scheme, masking, participants_chunk=pc, dim_chunk=dc,
+            uniform_tail=True)
+        out = agg.aggregate(x, key=jax.random.PRNGKey(3))
+        np.testing.assert_array_equal(out, expected,
+                                      err_msg=type(masking).__name__)
+        # THE point of the flag: a ragged round compiles one step shape
+        # and one finale shape
+        assert len(agg._steps) == 1, list(agg._steps)
+        assert len(agg._finals) == 1, list(agg._finals)
+        baseline = StreamingAggregator(
+            scheme, masking, participants_chunk=pc, dim_chunk=dc)
+        base_out = baseline.aggregate(x, key=jax.random.PRNGKey(3))
+        np.testing.assert_array_equal(out, base_out)
+        # the ragged tails it exists to avoid: full/tail shapes on both
+        # axes -> 4 separately compiled steps
+        assert len(baseline._steps) == 4, list(baseline._steps)
+
+
+def test_uniform_tail_chacha_and_additive_exact():
+    from sda_tpu.protocol import AdditiveSharing, ChaChaMasking
+
+    rng = np.random.default_rng(73)
+    P, d = 11, 100
+    x = rng.integers(0, 433, size=(P, d))
+    expected = x.sum(axis=0) % 433
+    for scheme, masking in [
+        (GOLDEN, ChaChaMasking(433, d, 128)),
+        (AdditiveSharing(share_count=8, modulus=433), ChaChaMasking(433, d, 128)),
+        (AdditiveSharing(share_count=8, modulus=433), FullMasking(433)),
+    ]:
+        agg = StreamingAggregator(
+            scheme, masking, participants_chunk=4, dim_chunk=48,
+            uniform_tail=True)
+        out = agg.aggregate(x, key=jax.random.PRNGKey(9))
+        np.testing.assert_array_equal(
+            out, expected,
+            err_msg=f"{type(scheme).__name__}/{type(masking).__name__}")
+
+
+def test_uniform_tail_single_tile_unchanged():
+    scheme = fast_scheme()
+    rng = np.random.default_rng(77)
+    x = rng.integers(0, 1 << 16, size=(5, 30))
+    a = StreamingAggregator(scheme, FullMasking(scheme.prime_modulus),
+                            participants_chunk=8, dim_chunk=3 << 20,
+                            uniform_tail=True)
+    out = a.aggregate(x, key=jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(out, x.sum(axis=0) % scheme.prime_modulus)
+    # dim < dim_chunk: the single tile keeps its grain-rounded size, not
+    # the full chunk width
+    (shape,) = a._steps
+    assert shape[1] < a.dim_chunk
+
+
+def test_uniform_tail_checkpoint_resume_and_fingerprint(tmp_path):
+    import os
+
+    from sda_tpu.mesh import synthetic_block_provider32
+
+    scheme = fast_scheme()
+    p = scheme.prime_modulus
+    prov = synthetic_block_provider32(p, seed=5, max_value=1 << 16)
+    key = jax.random.PRNGKey(8)
+    P, d = 10, 100
+
+    def agg(**kw):
+        return StreamingAggregator(scheme, FullMasking(p),
+                                   participants_chunk=4, dim_chunk=36, **kw)
+
+    ref = agg(uniform_tail=True).aggregate_blocks(prov, P, d, key)
+    exp = prov(0, P, 0, d).astype(np.int64).sum(axis=0) % p
+    np.testing.assert_array_equal(ref, exp)
+
+    # crash mid-round, resume bit-identically under uniform_tail
+    ck = str(tmp_path / "ut.ckpt.npz")
+    calls = {"n": 0}
+
+    def flaky(p0, p1, d0, d1):
+        calls["n"] += 1
+        if calls["n"] == 5:
+            raise RuntimeError("boom")
+        return prov(p0, p1, d0, d1)
+
+    with pytest.raises(RuntimeError):
+        agg(uniform_tail=True).aggregate_blocks(
+            flaky, P, d, key, checkpoint_path=ck, checkpoint_every_chunks=1)
+    assert os.path.exists(ck)
+    resumed = agg(uniform_tail=True)
+    out = resumed.aggregate_blocks(prov, P, d, key, checkpoint_path=ck,
+                                   checkpoint_every_chunks=1)
+    assert resumed.last_resumed
+    np.testing.assert_array_equal(out, ref)
+
+    # a snapshot written WITHOUT uniform_tail must not be resumed WITH it
+    # (accumulator shapes differ mid-round): fingerprints diverge
+    calls["n"] = 0
+    with pytest.raises(RuntimeError):
+        agg().aggregate_blocks(
+            flaky, P, d, key, checkpoint_path=ck, checkpoint_every_chunks=1)
+    fresh = agg(uniform_tail=True)
+    out2 = fresh.aggregate_blocks(prov, P, d, key, checkpoint_path=ck,
+                                  checkpoint_every_chunks=1)
+    assert not fresh.last_resumed  # foreign snapshot rejected, clean round
+    np.testing.assert_array_equal(out2, ref)
