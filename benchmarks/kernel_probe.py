@@ -61,7 +61,7 @@ def _emit(stage: str, **kw) -> None:
 # Parametric probe kernel (mirrors fused_mask_share_combine's structure)
 
 def probe_call(x_cols, seed, sp, m_host, t, *, do_x, do_prng, do_matmul,
-               tile, p_block, p_tile, interpret=False):
+               tile, p_block, p_tile, tree=False, interpret=False):
     """Variant of the fused kernel running only the selected components.
 
     Same grid (dim tiles x participant tiles), same fold/accumulate
@@ -69,6 +69,13 @@ def probe_call(x_cols, seed, sp, m_host, t, *, do_x, do_prng, do_matmul,
     pallas_round.fused_mask_share_combine — so component timings subtract
     cleanly. Output is always [n, B]; variants without the matmul write
     their [k, B] fold into the first k rows.
+
+    ``tree=True`` replaces the library's per-slice fold (adds on [rows,
+    TB] slices, rows = 3-8 of 8 sublanes per vreg) with a halving tree
+    over the flat [pb*rows, TB] block — every add runs at full sublane
+    density. Bit-exact (mod-p sums are order-free; canon cadence keeps
+    partials < 2^32); requires pb a power of two. If it wins on-chip, the
+    library kernel adopts it.
     """
     import jax
     import jax.numpy as jnp
@@ -104,6 +111,8 @@ def probe_call(x_cols, seed, sp, m_host, t, *, do_x, do_prng, do_matmul,
                 seed_ref[0],
                 pl.program_id(0) * jnp.int32(n_ptiles) + pl.program_id(1))
         fan = max(1, 0xFFFFFFFF // (sp.p - 1))
+        # raw-add tree levels before a canon: 2^L canonical terms < 2^32
+        max_lvl = max(1, int(math.floor(math.log2(fan))))
 
         def fold_slices(get, count):
             acc, partial, cnt = None, None, 0
@@ -117,6 +126,29 @@ def probe_call(x_cols, seed, sp, m_host, t, *, do_x, do_prng, do_matmul,
                     partial, cnt = None, 0
             return acc
 
+        def tree_fold(arr, group_rows):
+            """Σ of the ``m`` [group_rows, TB] slices stacked in ``arr``
+            (canonical residues), by halving the FULL block — dense
+            sublanes, log2(m) rounds. m must be a power of two."""
+            m = arr.shape[0] // group_rows
+            lvl = 0
+            while m > 1:
+                h = m // 2
+                arr = arr[: h * group_rows] + arr[h * group_rows:]
+                m = h
+                lvl += 1
+                if lvl == max_lvl or m == 1:
+                    arr = canon32(arr, sp)
+                    lvl = 0
+            return arr
+
+        def fold_block(arr, group_rows):
+            if tree:
+                return tree_fold(arr, group_rows)
+            return fold_slices(
+                lambda i: arr[i * group_rows: (i + 1) * group_rows],
+                arr.shape[0] // group_rows)
+
         def draw_sum(rows):
             bits = pltpu.bitcast(
                 pltpu.prng_random_bits((2 * pb * rows, tile)), _U32)
@@ -126,7 +158,7 @@ def probe_call(x_cols, seed, sp, m_host, t, *, do_x, do_prng, do_matmul,
             res = modadd32(
                 fastfield.mulmod32_const(canon32(hi, sp), r32, sp),
                 canon32(lo, sp), sp)
-            return fold_slices(lambda i: res[i * rows: (i + 1) * rows, :], pb)
+            return fold_block(res, rows)
 
         mh_k, mh_t = mh_ref[...][:, :k], mh_ref[...][:, k:]
         ml_k, ml_t = ml_ref[...][:, :k], ml_ref[...][:, k:]
@@ -140,7 +172,12 @@ def probe_call(x_cols, seed, sp, m_host, t, *, do_x, do_prng, do_matmul,
             values = None
             if do_x:
                 x_blk = x_ref[pl.ds(p0, pb)]
-                values = fold_slices(lambda i: canon32(x_blk[i], sp), pb)
+                if tree:
+                    flat = canon32(x_blk, sp).reshape(pb * k, tile)
+                    values = tree_fold(flat, k)
+                else:
+                    values = fold_slices(
+                        lambda i: canon32(x_blk[i], sp), pb)
             if do_prng:
                 msum = draw_sum(k)
                 values = msum if values is None else modadd32(
@@ -326,6 +363,21 @@ def main() -> int:
     if not fold_exact:
         return 1
 
+    pb_pow2 = pb & (pb - 1) == 0
+    if pb_pow2:
+        # dense-sublane halving tree: must reproduce the slice fold
+        tree_ref = jax.device_get(jax.jit(functools.partial(
+            probe_call, sp=sp, m_host=m_host, t=t, do_x=True,
+            do_prng=False, do_matmul=False, tree=True, tile=tile,
+            p_block=pb, p_tile=p_tile, interpret=interpret))(x_cols, 1))
+        tree_exact = bool(np.array_equal(tree_ref[:k], exp))
+        _emit("fold_tree_exact", ok=tree_exact)
+        if not tree_exact:
+            return 1
+    else:
+        _emit("fold_tree_exact", skipped=True,
+              detail=f"p_block {pb} not a power of two")
+
     ok = True
     if not interpret:
         # full variant must match the library kernel bit-for-bit: same
@@ -348,12 +400,21 @@ def main() -> int:
             ("no_matmul", dict(do_x=True, do_prng=True, do_matmul=False)),
             ("full", dict(do_x=True, do_prng=True, do_matmul=True)),
         ]
+        if pb_pow2:
+            # tree-fold A/B: same components, dense-sublane fold
+            variants += [
+                ("fold_tree", dict(do_x=True, do_prng=False,
+                                   do_matmul=False, tree=True)),
+                ("full_tree", dict(do_x=True, do_prng=True,
+                                   do_matmul=True, tree=True)),
+            ]
         secs = {}
+        jits = {}
         for name, flags in variants:
             # jit ONCE per variant: eager probe_call would re-trace every
             # dispatch, and that host cost differs per variant — it would
             # leak into the component subtraction as fake device time
-            jitted = jax.jit(functools.partial(
+            jitted = jits[name] = jax.jit(functools.partial(
                 probe_call, sp=sp, m_host=m_host, t=t, tile=tile,
                 p_block=pb, p_tile=p_tile, **flags))
 
@@ -364,6 +425,18 @@ def main() -> int:
             secs[name] = per
             _emit("component", name=name, ms=round(per * 1e3, 3),
                   el_per_s=round(elements / per, 1), **flags)
+        if pb_pow2:
+            # same seed + same draw order => the tree round must match the
+            # slice-fold round bit-for-bit (mod-p sums are order-free)
+            same = bool(np.array_equal(
+                jax.device_get(jits["full"](x_cols, 7)),
+                jax.device_get(jits["full_tree"](x_cols, 7))))
+            _emit("tree_ab", full_ms=round(secs["full"] * 1e3, 3),
+                  full_tree_ms=round(secs["full_tree"] * 1e3, 3),
+                  fold_ms=round(secs["fold_only"] * 1e3, 3),
+                  fold_tree_ms=round(secs["fold_tree"] * 1e3, 3),
+                  bit_identical=same)
+            ok = ok and same
         # every variant pays the grid/init/loop overhead O once:
         #   fold_only = O+F, prng_only = O+R, no_matmul = O+F+R,
         #   full = O+F+R+M  =>  solve for the four components

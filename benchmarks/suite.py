@@ -380,7 +380,10 @@ def _streaming_bench(name, participants, dim, max_seconds):
     acc_dtype = jnp.uint32 if agg._sp is not None else jnp.int64
     acc_shares = jnp.zeros((s.share_count, B), acc_dtype)
     acc_mask = jnp.zeros((dim_covered,), acc_dtype)
-    step = agg._step_fn((pc, dim_covered))
+    # seed the aggregator's step cache: the e2e rounds below run this
+    # exact shape (that agreement is what dim_covered guarantees), so
+    # they must not re-trace it inside a scarce window
+    step = agg._steps[(pc, dim_covered)] = agg._step_fn((pc, dim_covered))
 
     from sda_tpu.utils.benchtime import marginal_seconds
 
