@@ -73,11 +73,12 @@ def _run(platform: str, use_pallas: bool) -> dict:
 
         # sweepable kernel knobs (hardware tuning): participants folded per
         # matmul block, and the lane-dim tile width
-        from sda_tpu.utils.benchtime import pallas_knobs
+        from sda_tpu.utils.benchtime import pallas_knobs, tree_fold_knob
 
         p_block, tile = pallas_knobs()
         fn = jax.jit(single_chip_round_pallas(
             scheme, FullMasking(p), p_block=p_block, tile=tile,
+            tree_fold=tree_fold_knob(),
         ))
     else:
         fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
@@ -188,7 +189,7 @@ def _run(platform: str, use_pallas: bool) -> dict:
                     p_block, tile = pallas_knobs()
                     fn_t = jax.jit(single_chip_round_pallas(
                         scheme, FullMasking(p), p_block=p_block, tile=tile,
-                        dim_tile=dt))
+                        dim_tile=dt, tree_fold=tree_fold_knob()))
                 else:
                     fn_t = jax.jit(single_chip_round(
                         scheme, FullMasking(p), dim_tile=dt))

@@ -431,25 +431,69 @@ def main() -> int:
             os.environ["SDA_PALLAS_TILE"] = str(best["tile"])
             # sweep-sourced: small shapes may clamp it (simpod._pallas_stage)
             os.environ["SDA_PALLAS_TILE_SOURCE"] = "sweep"
+            # tree-fold A/B at the winning point (one extra compile):
+            # dense-sublane halving fold vs the slice fold. Bit-identical
+            # by construction; the verdict persists as a knob and flows
+            # to suite/bench via export_knobs_to_env
+            pb_best = int(best["p_block"])
+            tree_best = False
+            if pb_best >= 2 and (pb_best & (pb_best - 1)) == 0:
+                try:
+                    fn_tr = jax.jit(single_chip_round_pallas(
+                        scheme, FullMasking(p), p_block=pb_best,
+                        tile=best["tile"], tree_fold=True, **pallas_kw))
+                    out_tr = jax.device_get(fn_tr(big, key))
+                    tr_exact = bool(np.array_equal(out_tr, expected_big))
+                    per_tr, _tri = marginal_seconds(
+                        lambda i: fn_tr(big, jax.random.fold_in(key, i)),
+                        target_seconds=4)
+                    tr_rate = round(P * d / per_tr / 1e9, 2)
+                    tr_wins = tr_exact and tr_rate > best["gel_per_sec"]
+                    _emit("treefold_ab", ok=tr_exact, gel_per_sec=tr_rate,
+                          slice_gel_per_sec=best["gel_per_sec"],
+                          winner="tree" if tr_wins else "slice")
+                    with open(knobs_path) as kf:
+                        rec_tr = json.load(kf)
+                    rec_tr["tree_fold"] = bool(tr_wins)
+                    rec_tr["tree_fold_gel_per_sec"] = tr_rate
+                    with open(tmp_path, "w") as kf:
+                        json.dump(rec_tr, kf, indent=2)
+                    os.replace(tmp_path, knobs_path)
+                    if tr_wins:
+                        tree_best = True
+                        os.environ["SDA_PALLAS_TREEFOLD"] = "1"
+                except Exception as e:
+                    _emit("treefold_ab", ok=False,
+                          error=f"{type(e).__name__}: {str(e)[:200]}")
+            else:
+                _emit("treefold_ab", skipped=True,
+                      detail=f"p_block {pb_best} not a power of two")
             # dim-tiled monolithic A/B at the swept-best knobs: does the
             # scan-over-dim-tiles schedule beat the full-width kernel on
             # the flagship shape? The measured winner is persisted as the
             # dim_tile knob (0 = untiled won) and inherited by bench.py
             # via export_knobs_to_env
             try:
+                # measured under the fold that just won, so the record's
+                # dim_tile + tree_fold knobs describe ONE configuration
                 fn_t = jax.jit(single_chip_round_pallas(
                     scheme, FullMasking(p), p_block=best["p_block"],
-                    tile=best["tile"], dim_tile=dim_tile_w, **pallas_kw))
+                    tile=best["tile"], dim_tile=dim_tile_w,
+                    tree_fold=tree_best, **pallas_kw))
                 out_t = jax.device_get(fn_t(big, key))
                 t_exact = bool(np.array_equal(out_t, expected_big))
                 per_t, _ti = marginal_seconds(
                     lambda i: fn_t(big, jax.random.fold_in(key, i)),
                     target_seconds=4)
                 tiled_rate = round(P * d / per_t / 1e9, 2)
-                tiled_wins = t_exact and tiled_rate > best["gel_per_sec"]
+                # baseline = the best UNTILED rate under the same fold
+                untiled_rate = (tr_rate if tree_best
+                                else best["gel_per_sec"])
+                tiled_wins = t_exact and tiled_rate > untiled_rate
                 _emit("tiled_ab", ok=t_exact, dim_tile=dim_tile_w,
                       gel_per_sec=tiled_rate,
-                      untiled_gel_per_sec=best["gel_per_sec"],
+                      untiled_gel_per_sec=untiled_rate,
+                      tree_fold=tree_best,
                       winner="tiled" if tiled_wins else "untiled")
                 with open(knobs_path) as kf:
                     rec = json.load(kf)

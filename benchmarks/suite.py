@@ -196,11 +196,12 @@ def _round_bench(name, participants, dim, scheme=None):
     if use_pallas:
         from sda_tpu.fields.pallas_round import single_chip_round_pallas
 
-        from sda_tpu.utils.benchtime import pallas_knobs
+        from sda_tpu.utils.benchtime import pallas_knobs, tree_fold_knob
 
         p_block, tile = pallas_knobs()
         fn = jax.jit(single_chip_round_pallas(
             scheme, FullMasking(p), p_block=p_block, tile=tile,
+            tree_fold=tree_fold_knob(),
         ))
     else:
         fn = jax.jit(single_chip_round(scheme, FullMasking(p)))

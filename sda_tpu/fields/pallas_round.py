@@ -104,6 +104,7 @@ def fused_mask_share_combine(
     interpret: bool = False,
     p_block: int = 16,
     p_tile: Optional[int] = None,
+    tree_fold: bool = False,
 ):
     """[P, k, B] canonical uint32 columns -> ([n, B] combined shares,
     [k, B] mask totals).
@@ -118,6 +119,14 @@ def fused_mask_share_combine(
     from the VMEM budget when None) sets how many participants each
     grid-axis-1 block streams through VMEM. The mod-p algebra is exact,
     so neither size ever changes results.
+
+    ``tree_fold`` replaces the per-slice participant fold (adds on
+    [rows, TB] slices, rows = k or t of 8 sublanes per vreg) with a
+    halving tree over the flat [pb*rows, TB] block — every add at full
+    sublane density, log2(pb) rounds. Bit-identical output (mod-p sums
+    are order-free; canon cadence keeps raw partials < 2^32). Applied
+    only when the effective p_block is a power of two >= 2; otherwise
+    the slice fold runs as before.
     """
     P, k, B = x_cols.shape
     n, m2 = m_host.shape
@@ -161,6 +170,10 @@ def fused_mask_share_combine(
 
         # raw uint32 partial sums stay exact for `fan` canonical residues
         fan = max(1, 0xFFFFFFFF // (sp.p - 1))
+        # tree mode: raw-add levels between canons (2^L canonical terms
+        # stay < 2^32); slice-fold applies when pb is not a power of two
+        use_tree = tree_fold and pb >= 2 and (pb & (pb - 1)) == 0
+        max_lvl = max(1, int(math.floor(math.log2(fan))))
 
         def fold_slices(get, count):
             """Σ of ``get(i)`` (canonical [r, TB]) for i < count: raw adds,
@@ -176,6 +189,28 @@ def fused_mask_share_combine(
                     partial, cnt = None, 0
             return acc
 
+        def tree_fold_block(arr, group_rows):
+            """Σ of the stacked [group_rows, TB] slices in ``arr`` by
+            halving the FULL block — dense sublanes, log2(m) rounds."""
+            m = arr.shape[0] // group_rows
+            lvl = 0
+            while m > 1:
+                h = m // 2
+                arr = arr[: h * group_rows] + arr[h * group_rows:]
+                m = h
+                lvl += 1
+                if lvl == max_lvl or m == 1:
+                    arr = canon32(arr, sp)
+                    lvl = 0
+            return arr
+
+        def fold_block(arr, group_rows):
+            """Σ of the pb stacked [group_rows, TB] slices (canonical)."""
+            if use_tree:
+                return tree_fold_block(arr, group_rows)
+            return fold_slices(
+                lambda i: arr[i * group_rows: (i + 1) * group_rows], pb)
+
         def draw_sum(rows, row0, p0):
             """Σ over the pb participants of [rows, TB] uniform residues."""
             if internal:
@@ -185,13 +220,13 @@ def fused_mask_share_combine(
                 hi = bits[: pb * rows, :]
                 lo = bits[pb * rows :, :]
                 res = _uniform_from_bits(hi, lo, sp)          # [pb*rows, TB]
-                return fold_slices(
-                    lambda i: res[i * rows : (i + 1) * rows, :], pb
-                )
+                return fold_block(res, rows)
             blk = bits_ref[pl.ds(p0, pb)]                     # [pb, 2*draws, TB]
             hi = blk[:, 2 * row0 : 2 * row0 + rows, :]
             lo = blk[:, 2 * row0 + rows : 2 * (row0 + rows), :]
             res = _uniform_from_bits(hi, lo, sp)              # [pb, rows, TB]
+            if use_tree:
+                return tree_fold_block(res.reshape(pb * rows, tile), rows)
             return fold_slices(lambda i: res[i], pb)
 
         # matrix limb columns: first k drive the (masked) secrets, last t
@@ -217,10 +252,15 @@ def fused_mask_share_combine(
             # same bits: mod-p arithmetic is exact, so fold order is free.
             p0 = b_ix * np.int32(pb)
             x_blk = x_ref[pl.ds(p0, pb)]                      # [pb, k, TB]
-            # canon at first touch: fold_slices' raw-add fan bound needs
-            # terms < p, and the docstring contract (canonical inputs) is
+            # canon at first touch: the folds' raw-add bounds need terms
+            # < p, and the docstring contract (canonical inputs) is
             # otherwise unenforced
-            xsum = fold_slices(lambda i: canon32(x_blk[i], sp), pb)  # [k, TB]
+            if use_tree:
+                xsum = tree_fold_block(
+                    canon32(x_blk, sp).reshape(pb * k, tile), k)  # [k, TB]
+            else:
+                xsum = fold_slices(
+                    lambda i: canon32(x_blk[i], sp), pb)      # [k, TB]
             if masked:
                 masksum = draw_sum(k, 0, p0)                  # [k, TB]
                 values_k = modadd32(xsum, masksum, sp)
@@ -300,6 +340,7 @@ def single_chip_round_pallas(
     p_block: int = 16,
     p_tile: Optional[int] = None,
     dim_tile: Optional[int] = None,
+    tree_fold: bool = False,
 ):
     """Drop-in alternative to mesh.single_chip_round on the fused kernel.
 
@@ -364,7 +405,7 @@ def single_chip_round_pallas(
         shares, mask_tot = fused_mask_share_combine(
             x_cols, seed, sp, m_host, t, masked,
             tile=TB, external_bits=ext, interpret=interpret, p_block=pb,
-            p_tile=ptile_eff,
+            p_tile=ptile_eff, tree_fold=tree_fold,
         )
         from .sharing import packed_reconstruct32
 

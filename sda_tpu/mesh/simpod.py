@@ -314,7 +314,7 @@ def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
     primitive is unavailable.
     """
     from ..fields import pallas_round
-    from ..utils.benchtime import pallas_knobs, tile_from_sweep
+    from ..utils.benchtime import pallas_knobs, tile_from_sweep, tree_fold_knob
 
     chacha_mask_sum = None
     if isinstance(masking, ChaChaMasking):
@@ -351,6 +351,7 @@ def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
     shares, mask_tot = pallas_round.fused_mask_share_combine(
         x_cols, seed, f.sp, M_host, t, masked,
         tile=tile, external_bits=ext, interpret=interpret, p_block=p_block,
+        tree_fold=tree_fold_knob(),
     )
     shares = shares[:, :B0]
     if not masked:

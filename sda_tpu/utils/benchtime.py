@@ -143,7 +143,21 @@ def export_knobs_to_env() -> dict:
             # marked so a record verdict (measured on the pallas A/B only)
             # can be told apart from an explicit user disable
             os.environ["SDA_PALLAS_DIMTILE_SOURCE"] = "sweep"
+    if rec.get("tree_fold") is True:
+        os.environ.setdefault("SDA_PALLAS_TREEFOLD", "1")
     return rec
+
+
+def tree_fold_knob() -> bool:
+    """Dense-sublane tree fold inside the fused kernel:
+    SDA_PALLAS_TREEFOLD env ("1" enables), default off. Env-only in
+    library code like the other kernel knobs; the hardware A/B record's
+    tree_fold verdict arrives via export_knobs_to_env at bench entry
+    points. No-op (slice fold) when the effective p_block is not a power
+    of two — results are bit-identical either way."""
+    import os
+
+    return os.environ.get("SDA_PALLAS_TREEFOLD") == "1"
 
 
 #: default monolithic dim-tile width: 24-grain aligned, 3 tiles at the

@@ -151,3 +151,69 @@ def test_pallas_round_divisor_p_blocks(p_block):
     inputs = rng.integers(0, 1 << 20, size=(100, 3 * 128))
     out = np.asarray(fn(jnp.asarray(inputs), jax.random.PRNGKey(5)))
     np.testing.assert_array_equal(out, inputs.sum(axis=0) % s.prime_modulus)
+
+
+# -- tree fold: dense-sublane halving fold, bit-identical ------------------
+
+@pytest.mark.parametrize("masking", ["none", "full"])
+@pytest.mark.parametrize("p_block", [2, 4, 8])
+def test_tree_fold_bit_identical_to_slice_fold(masking, p_block):
+    """tree_fold=True must reproduce the slice fold bit-for-bit from the
+    same external bits (mod-p sums are order-free; the canon cadence
+    keeps raw partials inside uint32)."""
+    s = fast_scheme()
+    mask = FullMasking(s.prime_modulus) if masking == "full" else NoMasking()
+    rng = np.random.default_rng(31)
+    inputs = jnp.asarray(rng.integers(0, 1 << 20, size=(8, 504)))
+    key = jax.random.PRNGKey(14)
+    outs = {}
+    for tree in (False, True):
+        fn = single_chip_round_pallas(
+            s, mask, tile=128, interpret=True,
+            external_bits_fn=external_bits, p_block=p_block,
+            tree_fold=tree,
+        )
+        outs[tree] = np.asarray(fn(inputs, key))
+    np.testing.assert_array_equal(outs[True], outs[False])
+    np.testing.assert_array_equal(
+        outs[True], np.asarray(inputs).sum(axis=0) % s.prime_modulus)
+
+
+def test_tree_fold_shares_match_slice_shares_same_bits():
+    """At the kernel seam: combined shares and mask totals identical."""
+    s = fast_scheme()
+    sp = fastfield.SolinasPrime.try_from(s.prime_modulus)
+    k, t = s.secret_count, s.privacy_threshold
+    m_host = numtheory.packed_share_matrix(
+        k, s.share_count, t, s.prime_modulus, s.omega_secrets,
+        s.omega_shares)
+    P, d, tile = 8, 384, 128
+    B = d // k
+    rng = np.random.default_rng(33)
+    x = jnp.asarray(
+        rng.integers(0, s.prime_modulus, size=(P, d)).astype(np.uint32))
+    x_cols = batch_columns(x, k)
+    bits = external_bits(jax.random.PRNGKey(34), P, k + t, B)
+    got = {}
+    for tree in (False, True):
+        got[tree] = fused_mask_share_combine(
+            x_cols, 0, sp, m_host, t, True, tile=tile, external_bits=bits,
+            interpret=True, p_block=4, tree_fold=tree)
+    np.testing.assert_array_equal(
+        np.asarray(got[True][0]), np.asarray(got[False][0]))
+    np.testing.assert_array_equal(
+        np.asarray(got[True][1]), np.asarray(got[False][1]))
+
+
+def test_tree_fold_non_pow2_p_block_falls_back():
+    """A non-power-of-two effective p_block silently runs the slice fold
+    (the knob is a no-op, never an error)."""
+    s = fast_scheme()
+    rng = np.random.default_rng(35)
+    inputs = jnp.asarray(rng.integers(0, 1 << 20, size=(6, 336)))
+    fn = single_chip_round_pallas(
+        s, FullMasking(s.prime_modulus), tile=112, interpret=True,
+        external_bits_fn=external_bits, p_block=3, tree_fold=True)
+    out = np.asarray(fn(inputs, jax.random.PRNGKey(15)))
+    np.testing.assert_array_equal(
+        out, np.asarray(inputs).sum(axis=0) % s.prime_modulus)
