@@ -193,6 +193,15 @@ def _round_bench(name, participants, dim, scheme=None):
     dev = jax.devices()[0]
     dim = _cpu_scaled_dim(dim)
     use_pallas = dev.platform != "cpu" and os.environ.get("SDA_PALLAS", "1") == "1"
+    from sda_tpu.utils.benchtime import dim_tile_knob
+
+    # honor the hardware A/B's dim_tile VERDICT (sweep-persisted knob or
+    # explicit user env), but never tile by default: unlike bench.py —
+    # which measures the tiled schedule as its own labeled candidate —
+    # the suite records ONE number per config, so it runs the measured
+    # winner only when a verdict exists, smaller than the dim
+    dim_tile = dim_tile_knob(default=0)
+    dim_tile = dim_tile if dim_tile and dim_tile < dim else None
     if use_pallas:
         from sda_tpu.fields.pallas_round import single_chip_round_pallas
 
@@ -201,10 +210,11 @@ def _round_bench(name, participants, dim, scheme=None):
         p_block, tile = pallas_knobs()
         fn = jax.jit(single_chip_round_pallas(
             scheme, FullMasking(p), p_block=p_block, tile=tile,
-            tree_fold=tree_fold_knob(),
+            tree_fold=tree_fold_knob(), dim_tile=dim_tile,
         ))
     else:
-        fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
+        fn = jax.jit(single_chip_round(scheme, FullMasking(p),
+                                       dim_tile=dim_tile))
     rng = np.random.default_rng(0)
     inputs = jnp.asarray(
         rng.integers(0, 1 << 20, size=(participants, dim), dtype=np.uint32)
@@ -231,6 +241,7 @@ def _round_bench(name, participants, dim, scheme=None):
         "round_seconds_marginal": round(per_round, 5),
         "platform": dev.platform,
         "pallas": use_pallas,
+        "dim_tile": dim_tile or 0,
         **timing,
         "phases": _phase_breakdown(scheme, inputs, key),
     }
