@@ -1,0 +1,147 @@
+"""Embedded-participant flow: native C core compute, Python transport.
+
+The reference declares an ``/embeddable-client`` wrapping its client "in a
+C-friendly" API for mobile/embedded apps (reference README.md:196-204 —
+announced, never released into the repo). This module is the TPU build's
+analog, split the same way the reference intended:
+
+- ALL participant crypto (canonicalize -> mask -> additive-share ->
+  varint -> sealed boxes) runs in the native C core
+  (``sda_tpu.native.embed_participate`` / C ABI ``sda_embed_participate``
+  in native/src/sda_native.cpp) — the part an embedded app links;
+- service interaction (fetching the aggregation/committee, verifying key
+  signatures, uploading) stays host-side — here the Python client, in an
+  app whatever HTTP stack it already has.
+
+The sealed blobs are wire-compatible with the Python/TPU clerks and
+recipient (same zigzag-varint + libsodium sealedbox formats), so an
+embedded participant joins ordinary rounds: pinned end-to-end in
+tests/test_embed.py across the none/full/chacha masking lattice.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..protocol import (
+    AdditiveSharing,
+    ChaChaMasking,
+    Encryption,
+    FullMasking,
+    NoMasking,
+    Participation,
+    ParticipationId,
+)
+from ..protocol.errors import NotFound
+
+__all__ = ["new_participation_embedded", "participate_embedded"]
+
+
+def _sodium_pk(key) -> bytes:
+    if key.variant != "Sodium":
+        raise ValueError(
+            f"embedded participant needs Sodium keys, got {key.variant}")
+    return key.value.data
+
+
+def new_participation_embedded(
+    client, input: Sequence[int], aggregation_id
+) -> Participation:
+    """``SdaClient.new_participation`` with the crypto computed natively.
+
+    Supports the embeddable scope: additive sharing (the mobile-participant
+    scheme) with Sodium encryption and none/full/chacha masking; other
+    scheme combinations raise ``ValueError`` — use the full client.
+    """
+    from .. import native
+
+    secrets = np.asarray(input, dtype=np.int64)
+    aggregation = client.service.get_aggregation(client.agent, aggregation_id)
+    if aggregation is None:
+        raise NotFound("could not find aggregation")
+    if secrets.shape != (aggregation.vector_dimension,):
+        raise ValueError("the input length does not match the aggregation")
+    committee = client.service.get_committee(client.agent, aggregation_id)
+    if committee is None:
+        raise NotFound("could not find committee")
+
+    sharing = aggregation.committee_sharing_scheme
+    if not isinstance(sharing, AdditiveSharing):
+        raise ValueError(
+            "embedded participant supports additive sharing only; "
+            f"got {type(sharing).__name__}")
+    # the C core masks AND shares mod aggregation.modulus; a scheme-level
+    # modulus/dimension drifting from the aggregation would make clerks
+    # combine in a different ring and reveal silently-wrong sums (the
+    # Python masker/generator use the scheme fields, so the two paths
+    # agree only when the aggregation is self-consistent)
+    if sharing.modulus != aggregation.modulus:
+        raise ValueError(
+            f"sharing modulus {sharing.modulus} != aggregation modulus "
+            f"{aggregation.modulus}")
+    for scheme_name in ("recipient_encryption_scheme",
+                       "committee_encryption_scheme"):
+        scheme = getattr(aggregation, scheme_name)
+        if type(scheme).__name__ != "SodiumEncryption":
+            raise ValueError(
+                f"embedded participant needs Sodium {scheme_name}, "
+                f"got {type(scheme).__name__}")
+
+    masking = aggregation.masking_scheme
+    if isinstance(masking, NoMasking):
+        kind, seed_bits = "none", 0
+    elif isinstance(masking, FullMasking):
+        kind, seed_bits = "full", 0
+        if masking.modulus != aggregation.modulus:
+            raise ValueError(
+                f"masking modulus {masking.modulus} != aggregation "
+                f"modulus {aggregation.modulus}")
+    elif isinstance(masking, ChaChaMasking):
+        kind, seed_bits = "chacha", masking.seed_bitsize
+        if masking.modulus != aggregation.modulus:
+            raise ValueError(
+                f"masking modulus {masking.modulus} != aggregation "
+                f"modulus {aggregation.modulus}")
+        if masking.dimension != aggregation.vector_dimension:
+            raise ValueError(
+                f"ChaCha masking dimension {masking.dimension} != "
+                f"vector dimension {aggregation.vector_dimension}")
+    else:
+        raise ValueError(
+            f"unsupported masking {type(masking).__name__}")
+
+    recipient_pk = b""
+    if kind != "none":
+        recipient_pk = _sodium_pk(client._fetch_verified_key(
+            aggregation.recipient, aggregation.recipient_key))
+    clerk_ids, clerk_pks = [], []
+    for clerk_id, clerk_key_id in committee.clerks_and_keys:
+        clerk_ids.append(clerk_id)
+        clerk_pks.append(_sodium_pk(
+            client._fetch_verified_key(clerk_id, clerk_key_id)))
+
+    recipient_blob, clerk_blobs = native.embed_participate(
+        secrets, aggregation.modulus, sharing.share_count,
+        masking=kind, seed_bits=seed_bits,
+        recipient_pk=recipient_pk, clerk_pks=clerk_pks,
+    )
+    return Participation(
+        id=ParticipationId.random(),
+        participant=client.agent.id,
+        aggregation=aggregation.id,
+        recipient_encryption=(
+            Encryption.sodium(recipient_blob)
+            if recipient_blob is not None else None),
+        clerk_encryptions=[
+            (cid, Encryption.sodium(blob))
+            for cid, blob in zip(clerk_ids, clerk_blobs)
+        ],
+    )
+
+
+def participate_embedded(client, input: Sequence[int], aggregation_id) -> None:
+    """Build natively + upload (the embedded ``participate``)."""
+    client.upload_participation(
+        new_participation_embedded(client, input, aggregation_id))

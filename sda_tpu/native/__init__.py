@@ -19,7 +19,7 @@ import numpy as np
 _HERE = Path(__file__).parent
 _SRC = _HERE / "src" / "sda_native.cpp"
 _LIB_PATH = _HERE / "libsda_native.so"
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -32,7 +32,7 @@ def _compile() -> bool:
     # CPU without those ISA extensions, and it measured no speedup for
     # the __int128 Montgomery ladder anyway
     cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
-           str(_SRC), "-o", str(_LIB_PATH)]
+           str(_SRC), "-o", str(_LIB_PATH), "-ldl"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
@@ -80,6 +80,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.sda_powmod_batch.argtypes = [
             u64p, ctypes.c_int64, u64p, ctypes.c_int64, u64p, ctypes.c_int64,
             u64p, u64p,
+        ]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.sda_embed_participate.argtypes = [
+            i64p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            u8p, u8p, u8p, ctypes.c_int64, i64p,
         ]
         _lib = lib
         return lib
@@ -193,6 +199,64 @@ def powmod(base: int, exp: int, mod: int) -> int:
     if rc:
         raise ValueError("sda_powmod failed")
     return int.from_bytes(out.tobytes(), "little")
+
+
+_MASKING_KIND = {"none": 0, "full": 1, "chacha": 2}
+
+
+def embed_participate(
+    secret: Sequence[int], modulus: int, share_count: int,
+    masking: str = "none", seed_bits: int = 128,
+    recipient_pk: bytes = b"", clerk_pks: Sequence[bytes] = (),
+) -> tuple:
+    """The embeddable participant core (C ABI `sda_embed_participate`):
+    canonicalize -> mask -> additive-share -> varint -> sealed boxes, all
+    in native code. Returns ``(recipient_blob | None, [clerk_blob, ...])``
+    — raw sealedbox bytes wire-compatible with the Python clerks and
+    recipient. Reference analog: the declared-but-unreleased
+    /embeddable-client (reference README.md:196-204).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if masking not in _MASKING_KIND:
+        raise ValueError(f"masking must be one of {sorted(_MASKING_KIND)}")
+    if len(clerk_pks) != share_count:
+        raise ValueError("need one clerk public key per share")
+    if masking != "none" and len(recipient_pk) != 32:
+        raise ValueError("recipient_pk must be 32 bytes")
+    for pk in clerk_pks:
+        if len(pk) != 32:
+            raise ValueError("clerk public keys must be 32 bytes")
+    arr = np.ascontiguousarray(secret, dtype=np.int64)
+    if arr.ndim != 1:
+        raise ValueError("secret must be a vector")
+    dim = arr.shape[0]
+    seal_overhead = 48
+    # worst case: 10 varint bytes per value per blob, plus seed words
+    cap = (share_count + 1) * (10 * dim + seal_overhead + 128)
+    out = np.zeros(cap, dtype=np.uint8)
+    lens = np.zeros(1 + share_count, dtype=np.int64)
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    rpk = np.frombuffer(
+        recipient_pk.ljust(32, b"\0"), dtype=np.uint8).copy()
+    cpk = np.frombuffer(b"".join(clerk_pks), dtype=np.uint8).copy()
+    rc = lib.sda_embed_participate(
+        _i64(arr), dim, modulus, share_count,
+        _MASKING_KIND[masking], seed_bits,
+        rpk.ctypes.data_as(u8), cpk.ctypes.data_as(u8),
+        out.ctypes.data_as(u8), cap, _i64(lens),
+    )
+    if rc == 1:
+        raise RuntimeError("libsodium unavailable at runtime")
+    if rc:
+        raise ValueError(f"sda_embed_participate failed (rc={rc})")
+    blobs, pos = [], 0
+    for n in lens.tolist():
+        blobs.append(out[pos:pos + n].tobytes())
+        pos += n
+    recipient_blob = blobs[0] if lens[0] else None
+    return recipient_blob, blobs[1:]
 
 
 def powmod_batch(bases: Sequence[int], exp: int, mod: int) -> List[int]:

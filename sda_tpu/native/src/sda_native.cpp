@@ -14,6 +14,10 @@
 #include <cstdint>
 #include <cstring>
 
+#include <dlfcn.h>
+
+#include <vector>
+
 extern "C" {
 
 // (a[m,k] @ b[k,n]) mod p with exact 128-bit accumulation.
@@ -368,6 +372,212 @@ int sda_powmod_batch(const uint64_t* bases, int64_t count, const uint64_t* exp,
     return 0;
 }
 
-int sda_native_abi_version() { return 2; }
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Embeddable participant core.
+//
+// The reference declares (and never released) an /embeddable-client that
+// "wraps client and client-http to expose the client functionality in a
+// C-friendly" API for mobile/embedded apps (reference README.md:196-204).
+// This is the TPU build's analog of its compute half: the COMPLETE
+// participant crypto — canonicalize -> mask (none/full/chacha) ->
+// additive-share -> zigzag-varint -> libsodium sealed boxes — behind one
+// C call, wire-compatible with the Python clerks/recipient (same varint
+// and sealedbox formats, crypto/varint.py + crypto/sodium.py). Transport
+// stays with the embedding host, exactly the split the reference intended
+// (its embeddable client wrapped client-http separately).
+//
+// libsodium is loaded at RUNTIME (dlopen), so this file builds — and every
+// other export works — on machines without it; callers get return code 1.
+
+namespace {
+
+typedef int (*fn_sodium_init)(void);
+typedef void (*fn_randombytes_buf)(void*, size_t);
+typedef int (*fn_crypto_box_seal)(unsigned char*, const unsigned char*,
+                                  unsigned long long, const unsigned char*);
+
+struct Sodium {
+    fn_randombytes_buf randombytes = nullptr;
+    fn_crypto_box_seal seal = nullptr;
+    bool ok = false;
+};
+
+static Sodium& sodium() {
+    static Sodium s = [] {
+        Sodium r;
+        // keep in sync with crypto/sodium.py _SONAMES: a host where the
+        // Python client finds sodium must not fail the embedded core
+        const char* names[] = {"libsodium.so.23", "libsodium.so",
+                               "libsodium.so.26", "libsodium.so.18",
+                               nullptr};
+        void* h = nullptr;
+        for (int i = 0; names[i] && !h; ++i)
+            h = dlopen(names[i], RTLD_NOW);
+        if (!h) return r;
+        fn_sodium_init init = (fn_sodium_init)dlsym(h, "sodium_init");
+        r.randombytes = (fn_randombytes_buf)dlsym(h, "randombytes_buf");
+        r.seal = (fn_crypto_box_seal)dlsym(h, "crypto_box_seal");
+        // sodium_init: 0 fresh, 1 already initialized, -1 failure
+        if (init && r.randombytes && r.seal && init() >= 0) r.ok = true;
+        return r;
+    }();
+    return s;
+}
+
+const int64_t kSealBytes = 48;  // crypto_box_SEALBYTES (x25519 pk + MAC)
+
+// exact uniform draws in [0, m): rejection over u64 (no modulo bias),
+// bulk-filled — one randombytes_buf call per vector, per-lane redraw only
+// on rejection (probability < 2^-32 for the moduli in play)
+static void uniform_fill(Sodium& s, uint64_t m, int64_t* dst, int64_t n) {
+    const uint64_t zone =
+        (uint64_t)(((((unsigned __int128)1) << 64) / m) * m - 1);
+    std::vector<uint64_t> buf((size_t)n);
+    s.randombytes(buf.data(), (size_t)n * sizeof(uint64_t));
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t v = buf[(size_t)i];
+        while (v > zone) s.randombytes(&v, sizeof v);
+        dst[i] = (int64_t)(v % m);
+    }
+}
+
+// zigzag + LEB128, matching sda_tpu.crypto.varint (the reference's
+// integer-encoding VarInt inside sealed boxes, encryption/sodium.rs:36-45)
+static void varint_append(std::vector<uint8_t>& out, int64_t x) {
+    uint64_t u = ((uint64_t)x << 1) ^ (uint64_t)(x >> 63);
+    do {
+        uint8_t b = u & 0x7F;
+        u >>= 7;
+        if (u) b |= 0x80;
+        out.push_back(b);
+    } while (u);
+}
+
+static int seal_blob(Sodium& s, const std::vector<uint8_t>& msg,
+                     const uint8_t* pk, uint8_t* out, int64_t cap,
+                     int64_t* written) {
+    int64_t need = (int64_t)msg.size() + kSealBytes;
+    if (need > cap) return 2;
+    if (s.seal(out, msg.data(), (unsigned long long)msg.size(), pk) != 0)
+        return 4;
+    *written = need;
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Full participant compute for one aggregation input.
+//
+//   secret[dim]    any int64 values; canonicalized mod `modulus`
+//   masking_kind   0 = none, 1 = full, 2 = chacha (seed_bits in 32..256,
+//                  multiple of 32)
+//   recipient_pk   32-byte Curve25519 pk (ignored for masking none)
+//   clerk_pks      share_count x 32 bytes, committee order
+//   out/out_cap    packed output: [recipient blob][clerk 0 blob]...[n-1]
+//   out_lens       int64[1 + share_count]: recipient blob length (0 when
+//                  masking none), then each clerk blob length
+//
+// Sharing is additive (the mobile-participant scheme); Shamir committees
+// keep the Python/TPU client. Returns 0 ok, 1 libsodium unavailable,
+// 2 out_cap too small, 3 bad arguments, 4 sealing failure.
+int sda_embed_participate(
+    const int64_t* secret, int64_t dim, int64_t modulus,
+    int32_t share_count, int32_t masking_kind, int32_t seed_bits,
+    const uint8_t* recipient_pk, const uint8_t* clerk_pks,
+    uint8_t* out, int64_t out_cap, int64_t* out_lens) {
+    if (dim < 0 || modulus <= 0 || share_count < 1) return 3;
+    if (masking_kind < 0 || masking_kind > 2) return 3;
+    Sodium& s = sodium();
+    if (!s.ok) return 1;
+    const uint64_t m = (uint64_t)modulus;
+    std::vector<int64_t> masked((size_t)dim);
+    for (int64_t i = 0; i < dim; ++i) {
+        int64_t c = secret[i] % modulus;
+        if (c < 0) c += modulus;
+        masked[(size_t)i] = c;
+    }
+    std::vector<uint8_t> payload;
+    int64_t pos = 0, written = 0;
+    if (masking_kind == 0) {
+        out_lens[0] = 0;
+    } else if (masking_kind == 1) {
+        payload.reserve((size_t)dim * 5);
+        std::vector<int64_t> mask((size_t)dim);
+        uniform_fill(s, m, mask.data(), dim);
+        for (int64_t i = 0; i < dim; ++i) {
+            uint64_t v = (uint64_t)masked[(size_t)i]
+                       + (uint64_t)mask[(size_t)i];
+            if (v >= m) v -= m;
+            masked[(size_t)i] = (int64_t)v;
+            varint_append(payload, mask[(size_t)i]);
+        }
+        int rc = seal_blob(s, payload, recipient_pk, out + pos,
+                           out_cap - pos, &written);
+        if (rc) return rc;
+        out_lens[0] = written;
+        pos += written;
+    } else {
+        // ceil to whole 32-bit words, matching chacha.random_seed: any
+        // seed_bitsize the Python client accepts must work embedded too
+        if (seed_bits <= 0 || seed_bits > 256) return 3;
+        int words = (seed_bits + 31) / 32;
+        uint32_t seed[8] = {0};
+        s.randombytes(seed, (size_t)words * 4);
+        std::vector<int64_t> mask((size_t)dim);
+        if (sda_chacha_expand_mask(seed, words, dim, modulus, mask.data()))
+            return 3;
+        for (int64_t i = 0; i < dim; ++i) {
+            uint64_t v = (uint64_t)masked[(size_t)i]
+                       + (uint64_t)mask[(size_t)i];
+            if (v >= m) v -= m;
+            masked[(size_t)i] = (int64_t)v;
+        }
+        // the uploaded "mask" is the seed itself (masking/chacha.rs
+        // semantics): the recipient re-expands it
+        for (int w = 0; w < words; ++w)
+            varint_append(payload, (int64_t)seed[w]);
+        int rc = seal_blob(s, payload, recipient_pk, out + pos,
+                           out_cap - pos, &written);
+        if (rc) return rc;
+        out_lens[0] = written;
+        pos += written;
+    }
+    // additive shares: clerks 0..n-2 draw uniformly; the last share makes
+    // the column sums telescope to the masked secret (additive.rs:32-52)
+    std::vector<int64_t> acc((size_t)dim, 0);
+    std::vector<int64_t> share((size_t)dim);
+    for (int32_t c = 0; c < share_count; ++c) {
+        payload.clear();
+        if (c + 1 < share_count) {
+            uniform_fill(s, m, share.data(), dim);
+            for (int64_t i = 0; i < dim; ++i) {
+                uint64_t a = (uint64_t)acc[(size_t)i]
+                           + (uint64_t)share[(size_t)i];
+                if (a >= m) a -= m;
+                acc[(size_t)i] = (int64_t)a;
+            }
+        } else {
+            for (int64_t i = 0; i < dim; ++i) {
+                int64_t v = masked[(size_t)i] - acc[(size_t)i];
+                if (v < 0) v += modulus;
+                share[(size_t)i] = v;
+            }
+        }
+        for (int64_t i = 0; i < dim; ++i)
+            varint_append(payload, share[(size_t)i]);
+        int rc = seal_blob(s, payload, clerk_pks + (size_t)c * 32,
+                           out + pos, out_cap - pos, &written);
+        if (rc) return rc;
+        out_lens[1 + c] = written;
+        pos += written;
+    }
+    return 0;
+}
+
+int sda_native_abi_version() { return 3; }
 
 }  // extern "C"

@@ -1,0 +1,196 @@
+"""Embedded participant (native C core) joins ordinary Python rounds.
+
+The reference's declared-but-unreleased /embeddable-client (reference
+README.md:196-204) exposes the client compute "in a C-friendly" API for
+mobile/embedded apps. The TPU build's analog is
+``sda_embed_participate`` (native/src/sda_native.cpp) + the
+``client.embed`` transport shim. These tests pin the wire-compatibility
+claim end-to-end: a participation whose every byte of crypto was produced
+by the C core must decrypt, clerk, and reveal exactly alongside pure
+Python participants — across the none/full/chacha masking lattice.
+"""
+
+import numpy as np
+import pytest
+
+from sda_tpu import native
+from sda_tpu.client import SdaClient
+from sda_tpu.client.embed import new_participation_embedded, participate_embedded
+from sda_tpu.crypto import MemoryKeystore, sodium
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    ChaChaMasking,
+    EncryptionKeyId,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+    SodiumEncryption,
+)
+from sda_tpu.server import new_memory_server
+
+pytestmark = pytest.mark.skipif(
+    not (sodium.available() and native.available()),
+    reason="libsodium or native library not present",
+)
+
+DIM, MOD = 5, 433
+
+
+def _agg(masking) -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="embedded",
+        vector_dimension=DIM,
+        modulus=MOD,
+        recipient=AgentId.random(),
+        recipient_key=EncryptionKeyId.random(),
+        masking_scheme=masking,
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=MOD),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+
+
+def _client(service):
+    ks = MemoryKeystore()
+    c = SdaClient(SdaClient.new_agent(ks), ks, service)
+    c.upload_agent()
+    return c
+
+
+def _round(masking, embedded_input, python_inputs):
+    """One aggregation where ONE participation is built by the C core."""
+    service = new_memory_server()
+    recipient = _client(service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    agg = _agg(masking).replace(recipient=recipient.agent.id,
+                                recipient_key=rkey)
+    recipient.upload_aggregation(agg)
+    clerks = [_client(service) for _ in range(4)]
+    for c in clerks:
+        c.upload_encryption_key(c.new_encryption_key())
+    recipient.begin_aggregation(agg.id)
+
+    embedded = _client(service)
+    participate_embedded(embedded, embedded_input, agg.id)
+    for vals in python_inputs:
+        _client(service).participate(vals, agg.id)
+
+    recipient.end_aggregation(agg.id)
+    recipient.run_chores(-1)
+    for c in clerks:
+        c.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    expected = (np.asarray([embedded_input] + list(python_inputs))
+                .sum(axis=0) % MOD)
+    np.testing.assert_array_equal(out, expected)
+
+
+@pytest.mark.parametrize("masking", [
+    NoMasking(),
+    FullMasking(MOD),
+    ChaChaMasking(MOD, DIM, 128),
+], ids=["none", "full", "chacha"])
+def test_embedded_participation_reveals_exact(masking):
+    _round(masking,
+           embedded_input=[5, 10, 432, 0, 7],
+           python_inputs=[[1, 2, 3, 4, 5], [9, 8, 7, 6, 5]])
+
+
+def test_embedded_only_round():
+    """A round where EVERY participant is the C core."""
+    service = new_memory_server()
+    recipient = _client(service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    agg = _agg(FullMasking(MOD)).replace(recipient=recipient.agent.id,
+                                         recipient_key=rkey)
+    recipient.upload_aggregation(agg)
+    clerks = [_client(service) for _ in range(4)]
+    for c in clerks:
+        c.upload_encryption_key(c.new_encryption_key())
+    recipient.begin_aggregation(agg.id)
+    inputs = [[i + j for j in range(DIM)] for i in range(1, 4)]
+    for vals in inputs:
+        participate_embedded(_client(service), vals, agg.id)
+    recipient.end_aggregation(agg.id)
+    recipient.run_chores(-1)
+    for c in clerks:
+        c.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    np.testing.assert_array_equal(
+        out, np.asarray(inputs).sum(axis=0) % MOD)
+
+
+def test_embedded_canonicalizes_negative_and_large_inputs():
+    _round(NoMasking(),
+           embedded_input=[-1, MOD + 5, 2 * MOD, -MOD, 3],
+           python_inputs=[[1, 1, 1, 1, 1]])
+
+
+def test_embedded_rejects_shamir_committee():
+    service = new_memory_server()
+    recipient = _client(service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    agg = _agg(NoMasking()).replace(
+        recipient=recipient.agent.id, recipient_key=rkey,
+        committee_sharing_scheme=PackedShamirSharing(3, 8, 4, MOD, 354, 150),
+        vector_dimension=DIM,
+    )
+    recipient.upload_aggregation(agg)
+    for _ in range(8):
+        c = _client(service)
+        c.upload_encryption_key(c.new_encryption_key())
+    recipient.begin_aggregation(agg.id)
+    with pytest.raises(ValueError, match="additive sharing only"):
+        new_participation_embedded(_client(service), [1] * DIM, agg.id)
+
+
+def test_embed_core_blob_shapes():
+    """Direct C-ABI contract: blob counts/sizes and masking gating."""
+    pks = [sodium.box_keypair()[0] for _ in range(3)]
+    rpk, _ = sodium.box_keypair()
+    rec, clerk_blobs = native.embed_participate(
+        [1, 2, 3], MOD, 3, masking="none", clerk_pks=pks)
+    assert rec is None and len(clerk_blobs) == 3
+    for b in clerk_blobs:
+        assert len(b) >= 48 + 3  # sealedbox overhead + one byte per value
+    rec, _ = native.embed_participate(
+        [1, 2, 3], MOD, 3, masking="chacha", seed_bits=128,
+        recipient_pk=rpk, clerk_pks=pks)
+    # chacha uploads the SEED (4 words), not an O(d) mask
+    assert rec is not None and len(rec) <= 48 + 4 * 10
+    with pytest.raises(ValueError):
+        native.embed_participate([1], MOD, 2, masking="full",
+                                 recipient_pk=b"short", clerk_pks=pks[:2])
+
+
+def test_embedded_chacha_odd_seed_bits():
+    """seed_bitsize not a multiple of 32 rounds up to whole words, exactly
+    like chacha.random_seed — any Python-accepted aggregation must work."""
+    _round(ChaChaMasking(MOD, DIM, 80),
+           embedded_input=[4, 3, 2, 1, 0],
+           python_inputs=[[2, 2, 2, 2, 2]])
+
+
+def test_embedded_rejects_scheme_modulus_drift():
+    service = new_memory_server()
+    recipient = _client(service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    agg = _agg(NoMasking()).replace(
+        recipient=recipient.agent.id, recipient_key=rkey,
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=431),
+    )
+    recipient.upload_aggregation(agg)
+    for _ in range(4):
+        c = _client(service)
+        c.upload_encryption_key(c.new_encryption_key())
+    recipient.begin_aggregation(agg.id)
+    with pytest.raises(ValueError, match="sharing modulus"):
+        new_participation_embedded(_client(service), [1] * DIM, agg.id)
