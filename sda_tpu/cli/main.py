@@ -129,6 +129,10 @@ def build_parser() -> argparse.ArgumentParser:
                       help="largest participant count the encoding must "
                            "stay exact for (default 1024); bounds the "
                            "clip range")
+    part.add_argument("--embedded", action="store_true",
+                      help="compute the participation in the native C "
+                           "core (the embeddable-client path: additive "
+                           "sharing + Sodium encryption only)")
 
     return parser
 
@@ -488,7 +492,17 @@ def main(argv=None) -> int:
             print("error: nothing to participate with (integer values "
                   "or --model FILE)", file=sys.stderr)
             return 1
-        client.participate(values, agg_id)
+        if args.embedded:
+            from ..client.embed import participate_embedded
+
+            try:
+                participate_embedded(client, values, agg_id)
+            except (NotFound, RuntimeError, ValueError) as e:
+                print(f"error: embedded participation failed: {e}",
+                      file=sys.stderr)
+                return 1
+        else:
+            client.participate(values, agg_id)
         return 0
 
     return 1

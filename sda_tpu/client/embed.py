@@ -33,6 +33,7 @@ from ..protocol import (
     NoMasking,
     Participation,
     ParticipationId,
+    SodiumEncryption,
 )
 from ..protocol.errors import NotFound
 
@@ -84,7 +85,7 @@ def new_participation_embedded(
     for scheme_name in ("recipient_encryption_scheme",
                        "committee_encryption_scheme"):
         scheme = getattr(aggregation, scheme_name)
-        if type(scheme).__name__ != "SodiumEncryption":
+        if not isinstance(scheme, SodiumEncryption):
             raise ValueError(
                 f"embedded participant needs Sodium {scheme_name}, "
                 f"got {type(scheme).__name__}")

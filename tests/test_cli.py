@@ -364,3 +364,40 @@ def test_cli_profile_and_chosen_committee(httpd, tmp_path, capsys):
               "--clerk", chosen[0], "--clerk", chosen[1],
               "--clerk", nokey_id, rc=1).err
     assert "not a committee candidate" in err
+
+
+def test_cli_embedded_participation(httpd, tmp_path, capsys):
+    """`participate --embedded`: the C-core participation over real REST,
+    mixed with a Python participant — the walkthrough sum must still be
+    exact (the embeddable-client path, reference README.md:196-204)."""
+    from sda_tpu import native
+    from sda_tpu.crypto import sodium
+
+    if not (sodium.available() and native.available()):
+        pytest.skip("libsodium or native library not present")
+    url = httpd.address
+
+    def sda(identity, *args):
+        rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity),
+                       *args])
+        assert rc == 0
+        return capsys.readouterr().out.strip()
+
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "agent", "create")
+        sda(who, "agent", "keys", "create")
+    for who in ("part-1", "part-2"):
+        sda(who, "agent", "create")
+
+    agg_id = sda(
+        "recipient", "aggregations", "create", "embedded-round",
+        "--dimension", "4", "--modulus", "433", "--shares", "3",
+        "--mask", "chacha",
+    )
+    sda("recipient", "aggregations", "begin", agg_id)
+    sda("part-1", "participate", agg_id, "1", "2", "3", "4", "--embedded")
+    sda("part-2", "participate", agg_id, "10", "20", "30", "40")
+    sda("recipient", "aggregations", "end", agg_id)
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "clerk", "--once")
+    assert sda("recipient", "aggregations", "reveal", agg_id) == "11 22 33 44"
