@@ -589,6 +589,56 @@ def bench_paillier_2048():
     }
 
 
+def bench_embedded_core():
+    """Embeddable participant core throughput (host C ABI): the complete
+    mobile-participant compute — canonicalize -> mask -> additive-share ->
+    varint -> sealed boxes — at a phone-sized update vector. Anchors the
+    reference's 'optimised to run on relatively weak and sporadic
+    devices' claim (reference README.md:8-11) with a measured number for
+    the embeddable-client analog (native/src/sda_native.cpp)."""
+    from sda_tpu import native
+    from sda_tpu.crypto import sodium
+
+    if not (sodium.available() and native.available()):
+        return {
+            "config": "embedded-10k",
+            "error": "libsodium or native library unavailable",
+            "platform": "host",
+        }
+    dim, shares, mod = 10_000, 3, (1 << 29) - 679
+    rng = np.random.default_rng(5)
+    secret = rng.integers(0, 1 << 20, size=dim).astype(np.int64)
+    clerk_pks = [sodium.box_keypair()[0] for _ in range(shares)]
+    rpk, _ = sodium.box_keypair()
+    results = {}
+    for masking in ("none", "full", "chacha"):
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 1.0:
+            native.embed_participate(
+                secret, mod, shares, masking=masking, seed_bits=128,
+                recipient_pk=rpk, clerk_pks=clerk_pks)
+            reps += 1
+        per = (time.perf_counter() - t0) / reps
+        results[masking] = {
+            "participation_ms": round(per * 1e3, 2),
+            "elements_per_sec": round(dim / per, 1),
+        }
+    return {
+        "config": "embedded-10k",
+        "metric": f"embedded participant core, full participation build "
+                  f"({dim}-dim update, {shares} clerks, sealedboxes "
+                  f"included)",
+        "value": results["full"]["elements_per_sec"],
+        "unit": "masked+shared+sealed elements/sec (single host core)",
+        "platform": "host",
+        "per_masking": results,
+        "note": "the C-ABI mobile-participant path "
+                "(sda_embed_participate); clerk/recipient sides are the "
+                "TPU benches above",
+    }
+
+
 def bench_paillier_premix():
     """Accelerator Paillier premixing vs the host bigint fold (round-3
     verdict #7): the server's homomorphic premix-combine hot loop
@@ -679,6 +729,7 @@ CONFIGS = {
     "readme-walkthrough": lambda: bench_readme_walkthrough(),
     "paillier-2048": lambda: bench_paillier_2048(),
     "paillier-premix": lambda: bench_paillier_premix(),
+    "embedded-10k": lambda: bench_embedded_core(),
     "packed-1m": lambda: _round_bench("packed-1m", 100, 999_999),
     "basic-1m": lambda: _round_bench("basic-1m", 100, 999_999,
                                      scheme=_basic_scheme()),
