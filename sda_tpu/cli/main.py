@@ -132,7 +132,7 @@ def build_parser() -> argparse.ArgumentParser:
     part.add_argument("--embedded", action="store_true",
                       help="compute the participation in the native C "
                            "core (the embeddable-client path: additive "
-                           "sharing + Sodium encryption only)")
+                           "or Shamir sharing, Sodium encryption)")
 
     return parser
 

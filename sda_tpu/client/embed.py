@@ -27,10 +27,12 @@ import numpy as np
 
 from ..protocol import (
     AdditiveSharing,
+    BasicShamirSharing,
     ChaChaMasking,
     Encryption,
     FullMasking,
     NoMasking,
+    PackedShamirSharing,
     Participation,
     ParticipationId,
     SodiumEncryption,
@@ -52,9 +54,11 @@ def new_participation_embedded(
 ) -> Participation:
     """``SdaClient.new_participation`` with the crypto computed natively.
 
-    Supports the embeddable scope: additive sharing (the mobile-participant
-    scheme) with Sodium encryption and none/full/chacha masking; other
-    scheme combinations raise ``ValueError`` — use the full client.
+    Supports the full scheme lattice an embedded participant meets:
+    additive, packed-Shamir, and BasicShamir sharing (Shamir share
+    matrices are computed host-side and evaluated in C) with Sodium
+    encryption and none/full/chacha masking; other combinations raise
+    ``ValueError`` — use the full client.
     """
     from .. import native
 
@@ -69,18 +73,31 @@ def new_participation_embedded(
         raise NotFound("could not find committee")
 
     sharing = aggregation.committee_sharing_scheme
-    if not isinstance(sharing, AdditiveSharing):
+    share_matrix, secret_count = None, 0
+    if isinstance(sharing, AdditiveSharing):
+        sharing_modulus = sharing.modulus
+    elif isinstance(sharing, (PackedShamirSharing, BasicShamirSharing)):
+        # the polynomial number theory stays host-side: the C core takes
+        # the share MATRIX (numtheory.share_matrix_for) and evaluates it
+        from ..fields import numtheory
+
+        sharing_modulus = sharing.prime_modulus
+        share_matrix = numtheory.share_matrix_for(sharing)
+        secret_count = sharing.secret_count
+    else:
         raise ValueError(
-            "embedded participant supports additive sharing only; "
+            "embedded participant supports additive and Shamir sharing; "
             f"got {type(sharing).__name__}")
-    # the C core masks AND shares mod aggregation.modulus; a scheme-level
-    # modulus/dimension drifting from the aggregation would make clerks
-    # combine in a different ring and reveal silently-wrong sums (the
-    # Python masker/generator use the scheme fields, so the two paths
-    # agree only when the aggregation is self-consistent)
-    if sharing.modulus != aggregation.modulus:
+    # ring discipline mirrors the Python client exactly: additive rounds
+    # live in ONE ring (sharing modulus == aggregation modulus); Shamir
+    # shares ride the scheme's NTT prime, which may exceed the
+    # aggregation modulus (the CLI/protocol policy gives participant-sum
+    # headroom) — masks stay in the masking scheme's own ring. Drifts the
+    # Python path would also mis-handle raise here instead of revealing
+    # silently-wrong sums.
+    if share_matrix is None and sharing_modulus != aggregation.modulus:
         raise ValueError(
-            f"sharing modulus {sharing.modulus} != aggregation modulus "
+            f"sharing modulus {sharing_modulus} != aggregation modulus "
             f"{aggregation.modulus}")
     for scheme_name in ("recipient_encryption_scheme",
                        "committee_encryption_scheme"):
@@ -91,24 +108,34 @@ def new_participation_embedded(
                 f"got {type(scheme).__name__}")
 
     masking = aggregation.masking_scheme
+    mask_modulus = None
     if isinstance(masking, NoMasking):
         kind, seed_bits = "none", 0
-    elif isinstance(masking, FullMasking):
-        kind, seed_bits = "full", 0
-        if masking.modulus != aggregation.modulus:
+    elif isinstance(masking, (FullMasking, ChaChaMasking)):
+        if isinstance(masking, ChaChaMasking):
+            kind, seed_bits = "chacha", masking.seed_bitsize
+            if masking.dimension != aggregation.vector_dimension:
+                raise ValueError(
+                    f"ChaCha masking dimension {masking.dimension} != "
+                    f"vector dimension {aggregation.vector_dimension}")
+        else:
+            kind, seed_bits = "full", 0
+        mask_modulus = masking.modulus
+        if mask_modulus > sharing_modulus:
             raise ValueError(
-                f"masking modulus {masking.modulus} != aggregation "
-                f"modulus {aggregation.modulus}")
-    elif isinstance(masking, ChaChaMasking):
-        kind, seed_bits = "chacha", masking.seed_bitsize
-        if masking.modulus != aggregation.modulus:
+                f"masking modulus {mask_modulus} exceeds the sharing "
+                f"modulus {sharing_modulus}: masked values would wrap")
+        if share_matrix is None and mask_modulus != sharing_modulus:
+            # one-ring discipline for additive rounds (see above)
             raise ValueError(
-                f"masking modulus {masking.modulus} != aggregation "
-                f"modulus {aggregation.modulus}")
-        if masking.dimension != aggregation.vector_dimension:
+                f"masking modulus {mask_modulus} != sharing modulus "
+                f"{sharing_modulus}")
+        if mask_modulus != aggregation.modulus:
+            # the recipient unmasks in the MASK ring; a ring different
+            # from the aggregation's reveals sums mod the wrong modulus
             raise ValueError(
-                f"ChaCha masking dimension {masking.dimension} != "
-                f"vector dimension {aggregation.vector_dimension}")
+                f"masking modulus {mask_modulus} != aggregation modulus "
+                f"{aggregation.modulus}")
     else:
         raise ValueError(
             f"unsupported masking {type(masking).__name__}")
@@ -124,9 +151,11 @@ def new_participation_embedded(
             client._fetch_verified_key(clerk_id, clerk_key_id)))
 
     recipient_blob, clerk_blobs = native.embed_participate(
-        secrets, aggregation.modulus, sharing.share_count,
+        secrets, sharing_modulus, sharing.output_size,
         masking=kind, seed_bits=seed_bits,
         recipient_pk=recipient_pk, clerk_pks=clerk_pks,
+        share_matrix=share_matrix, secret_count=secret_count,
+        mask_modulus=mask_modulus,
     )
     return Participation(
         id=ParticipationId.random(),

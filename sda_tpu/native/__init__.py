@@ -19,7 +19,7 @@ import numpy as np
 _HERE = Path(__file__).parent
 _SRC = _HERE / "src" / "sda_native.cpp"
 _LIB_PATH = _HERE / "libsda_native.so"
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -85,6 +85,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.sda_embed_participate.argtypes = [
             i64p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            u8p, u8p, u8p, ctypes.c_int64, i64p,
+        ]
+        lib.sda_embed_participate_shamir.argtypes = [
+            i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i64p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
             u8p, u8p, u8p, ctypes.c_int64, i64p,
         ]
         _lib = lib
@@ -208,13 +214,19 @@ def embed_participate(
     secret: Sequence[int], modulus: int, share_count: int,
     masking: str = "none", seed_bits: int = 128,
     recipient_pk: bytes = b"", clerk_pks: Sequence[bytes] = (),
+    share_matrix=None, secret_count: int = 0,
+    mask_modulus: Optional[int] = None,
 ) -> tuple:
-    """The embeddable participant core (C ABI `sda_embed_participate`):
-    canonicalize -> mask -> additive-share -> varint -> sealed boxes, all
-    in native code. Returns ``(recipient_blob | None, [clerk_blob, ...])``
-    — raw sealedbox bytes wire-compatible with the Python clerks and
-    recipient. Reference analog: the declared-but-unreleased
-    /embeddable-client (reference README.md:196-204).
+    """The embeddable participant core (C ABI `sda_embed_participate` /
+    `sda_embed_participate_shamir`): canonicalize -> mask -> share ->
+    varint -> sealed boxes, all in native code. Additive sharing by
+    default; pass ``share_matrix`` ([share_count, 1+k+t] canonical
+    residues from numtheory.share_matrix_for) + ``secret_count`` for
+    packed-Shamir/BasicShamir committees. Returns
+    ``(recipient_blob | None, [clerk_blob, ...])`` — raw sealedbox bytes
+    wire-compatible with the Python clerks and recipient. Reference
+    analog: the declared-but-unreleased /embeddable-client (reference
+    README.md:196-204).
     """
     lib = _load()
     if lib is None:
@@ -241,16 +253,35 @@ def embed_participate(
     rpk = np.frombuffer(
         recipient_pk.ljust(32, b"\0"), dtype=np.uint8).copy()
     cpk = np.frombuffer(b"".join(clerk_pks), dtype=np.uint8).copy()
-    rc = lib.sda_embed_participate(
-        _i64(arr), dim, modulus, share_count,
-        _MASKING_KIND[masking], seed_bits,
-        rpk.ctypes.data_as(u8), cpk.ctypes.data_as(u8),
-        out.ctypes.data_as(u8), cap, _i64(lens),
-    )
+    if share_matrix is None:
+        rc = lib.sda_embed_participate(
+            _i64(arr), dim, modulus, share_count,
+            _MASKING_KIND[masking], seed_bits,
+            rpk.ctypes.data_as(u8), cpk.ctypes.data_as(u8),
+            out.ctypes.data_as(u8), cap, _i64(lens),
+        )
+        what = "sda_embed_participate"
+    else:
+        mat = np.ascontiguousarray(share_matrix, dtype=np.int64) % modulus
+        if mat.ndim != 2 or mat.shape[0] != share_count:
+            raise ValueError(
+                "share_matrix must be [share_count, 1+k+t]")
+        m2 = mat.shape[1]
+        if not 1 <= secret_count <= m2 - 1:
+            raise ValueError("secret_count inconsistent with share_matrix")
+        rc = lib.sda_embed_participate_shamir(
+            _i64(arr), dim, modulus,
+            mask_modulus if mask_modulus is not None else modulus,
+            _i64(mat), share_count, m2, secret_count,
+            _MASKING_KIND[masking], seed_bits,
+            rpk.ctypes.data_as(u8), cpk.ctypes.data_as(u8),
+            out.ctypes.data_as(u8), cap, _i64(lens),
+        )
+        what = "sda_embed_participate_shamir"
     if rc == 1:
         raise RuntimeError("libsodium unavailable at runtime")
     if rc:
-        raise ValueError(f"sda_embed_participate failed (rc={rc})")
+        raise ValueError(f"{what} failed (rc={rc})")
     blobs, pos = [], 0
     for n in lens.tolist():
         blobs.append(out[pos:pos + n].tobytes())

@@ -466,6 +466,60 @@ static int seal_blob(Sodium& s, const std::vector<uint8_t>& msg,
     return 0;
 }
 
+// Canonicalize the secrets into `masked`, apply the masking scheme, and
+// seal the recipient payload (mask vector / chacha seed) at `out`.
+// Shared by the additive and Shamir entry points; *rec_written = 0 for
+// masking none. Returns the usual embed rc.
+static int mask_phase(Sodium& s, const int64_t* secret, int64_t dim,
+                      int64_t modulus, int32_t masking_kind,
+                      int32_t seed_bits, const uint8_t* recipient_pk,
+                      uint8_t* out, int64_t out_cap,
+                      std::vector<int64_t>& masked, int64_t* rec_written) {
+    const uint64_t m = (uint64_t)modulus;
+    masked.resize((size_t)dim);
+    for (int64_t i = 0; i < dim; ++i) {
+        int64_t c = secret[i] % modulus;
+        if (c < 0) c += modulus;
+        masked[(size_t)i] = c;
+    }
+    *rec_written = 0;
+    if (masking_kind == 0) return 0;
+    std::vector<uint8_t> payload;
+    if (masking_kind == 1) {
+        payload.reserve((size_t)dim * 5);
+        std::vector<int64_t> mask((size_t)dim);
+        uniform_fill(s, m, mask.data(), dim);
+        for (int64_t i = 0; i < dim; ++i) {
+            uint64_t v = (uint64_t)masked[(size_t)i]
+                       + (uint64_t)mask[(size_t)i];
+            if (v >= m) v -= m;
+            masked[(size_t)i] = (int64_t)v;
+            varint_append(payload, mask[(size_t)i]);
+        }
+    } else {
+        // ceil to whole 32-bit words, matching chacha.random_seed: any
+        // seed_bitsize the Python client accepts must work embedded too
+        if (seed_bits <= 0 || seed_bits > 256) return 3;
+        int words = (seed_bits + 31) / 32;
+        uint32_t seed[8] = {0};
+        s.randombytes(seed, (size_t)words * 4);
+        std::vector<int64_t> mask((size_t)dim);
+        if (sda_chacha_expand_mask(seed, words, dim, modulus, mask.data()))
+            return 3;
+        for (int64_t i = 0; i < dim; ++i) {
+            uint64_t v = (uint64_t)masked[(size_t)i]
+                       + (uint64_t)mask[(size_t)i];
+            if (v >= m) v -= m;
+            masked[(size_t)i] = (int64_t)v;
+        }
+        // the uploaded "mask" is the seed itself (masking/chacha.rs
+        // semantics): the recipient re-expands it
+        for (int w = 0; w < words; ++w)
+            varint_append(payload, (int64_t)seed[w]);
+    }
+    return seal_blob(s, payload, recipient_pk, out, out_cap, rec_written);
+}
+
 }  // namespace
 
 extern "C" {
@@ -494,58 +548,14 @@ int sda_embed_participate(
     Sodium& s = sodium();
     if (!s.ok) return 1;
     const uint64_t m = (uint64_t)modulus;
-    std::vector<int64_t> masked((size_t)dim);
-    for (int64_t i = 0; i < dim; ++i) {
-        int64_t c = secret[i] % modulus;
-        if (c < 0) c += modulus;
-        masked[(size_t)i] = c;
-    }
+    std::vector<int64_t> masked;
     std::vector<uint8_t> payload;
     int64_t pos = 0, written = 0;
-    if (masking_kind == 0) {
-        out_lens[0] = 0;
-    } else if (masking_kind == 1) {
-        payload.reserve((size_t)dim * 5);
-        std::vector<int64_t> mask((size_t)dim);
-        uniform_fill(s, m, mask.data(), dim);
-        for (int64_t i = 0; i < dim; ++i) {
-            uint64_t v = (uint64_t)masked[(size_t)i]
-                       + (uint64_t)mask[(size_t)i];
-            if (v >= m) v -= m;
-            masked[(size_t)i] = (int64_t)v;
-            varint_append(payload, mask[(size_t)i]);
-        }
-        int rc = seal_blob(s, payload, recipient_pk, out + pos,
-                           out_cap - pos, &written);
-        if (rc) return rc;
-        out_lens[0] = written;
-        pos += written;
-    } else {
-        // ceil to whole 32-bit words, matching chacha.random_seed: any
-        // seed_bitsize the Python client accepts must work embedded too
-        if (seed_bits <= 0 || seed_bits > 256) return 3;
-        int words = (seed_bits + 31) / 32;
-        uint32_t seed[8] = {0};
-        s.randombytes(seed, (size_t)words * 4);
-        std::vector<int64_t> mask((size_t)dim);
-        if (sda_chacha_expand_mask(seed, words, dim, modulus, mask.data()))
-            return 3;
-        for (int64_t i = 0; i < dim; ++i) {
-            uint64_t v = (uint64_t)masked[(size_t)i]
-                       + (uint64_t)mask[(size_t)i];
-            if (v >= m) v -= m;
-            masked[(size_t)i] = (int64_t)v;
-        }
-        // the uploaded "mask" is the seed itself (masking/chacha.rs
-        // semantics): the recipient re-expands it
-        for (int w = 0; w < words; ++w)
-            varint_append(payload, (int64_t)seed[w]);
-        int rc = seal_blob(s, payload, recipient_pk, out + pos,
-                           out_cap - pos, &written);
-        if (rc) return rc;
-        out_lens[0] = written;
-        pos += written;
-    }
+    int rc0 = mask_phase(s, secret, dim, modulus, masking_kind, seed_bits,
+                         recipient_pk, out, out_cap, masked, &written);
+    if (rc0) return rc0;
+    out_lens[0] = written;
+    pos += written;
     // additive shares: clerks 0..n-2 draw uniformly; the last share makes
     // the column sums telescope to the masked secret (additive.rs:32-52)
     std::vector<int64_t> acc((size_t)dim, 0);
@@ -578,6 +588,88 @@ int sda_embed_participate(
     return 0;
 }
 
-int sda_native_abi_version() { return 3; }
+// Packed-Shamir variant: the share MATRIX is computed host-side (the
+// NTT/Vandermonde number theory stays in fields/numtheory.py) and passed
+// in as canonical residues; the core batches the masked vector into
+// ceil(dim/k) columns of k secrets (batched.rs:18-53 semantics: values
+// vector per batch = [0, secrets_k, randomness_t]), evaluates shares as
+// [n, m2] @ [m2] modmuls with 128-bit accumulation, and streams clerk i's
+// per-batch share into its sealed payload. modulus < 2^62.
+//
+//   share_modulus the sharing prime p: shares/partial sums live mod p
+//   mask_modulus  the masking ring (<= p): the CLI/protocol policy draws
+//                 masks mod the AGGREGATION modulus while Shamir shares
+//                 ride a larger NTT prime with participant-sum headroom
+//                 (masked values < mask_modulus <= p are shared verbatim;
+//                 pass mask_modulus == share_modulus when they coincide)
+//   m_host        n_shares x m2 canonical residues, row-major
+//   m2            1 + secret_count + privacy_threshold
+//   out_lens      int64[1 + n_shares], as in sda_embed_participate
+int sda_embed_participate_shamir(
+    const int64_t* secret, int64_t dim, int64_t share_modulus,
+    int64_t mask_modulus,
+    const int64_t* m_host, int32_t n_shares, int32_t m2, int32_t k,
+    int32_t masking_kind, int32_t seed_bits,
+    const uint8_t* recipient_pk, const uint8_t* clerk_pks,
+    uint8_t* out, int64_t out_cap, int64_t* out_lens) {
+    if (dim < 0 || share_modulus <= 0 || n_shares < 1) return 3;
+    if (mask_modulus <= 0 || mask_modulus > share_modulus) return 3;
+    if (k < 1 || m2 < k + 1) return 3;
+    if (share_modulus >= (int64_t)1 << 62) return 3;  // u128 accum bound
+    if (masking_kind < 0 || masking_kind > 2) return 3;
+    Sodium& s = sodium();
+    if (!s.ok) return 1;
+    const uint64_t m = (uint64_t)share_modulus;
+    std::vector<int64_t> masked;
+    int64_t pos = 0, written = 0;
+    int rc0 = mask_phase(s, secret, dim, mask_modulus, masking_kind,
+                         seed_bits, recipient_pk, out, out_cap, masked,
+                         &written);
+    if (rc0) return rc0;
+    out_lens[0] = written;
+    pos += written;
+    const int32_t t = m2 - 1 - k;
+    const int64_t B = (dim + k - 1) / k;
+    std::vector<std::vector<uint8_t>> clerk_payloads((size_t)n_shares);
+    for (auto& p : clerk_payloads) p.reserve((size_t)B * 5);
+    std::vector<int64_t> rands((size_t)(B * t));
+    if (t > 0) uniform_fill(s, m, rands.data(), B * t);
+    std::vector<uint64_t> vals((size_t)m2);
+    for (int64_t b = 0; b < B; ++b) {
+        vals[0] = 0;  // the share matrix's fixed zero column
+        for (int32_t j = 0; j < k; ++j) {
+            int64_t idx = b * k + j;  // zero-padded final batch
+            vals[(size_t)(1 + j)] =
+                idx < dim ? (uint64_t)masked[(size_t)idx] : 0;
+        }
+        for (int32_t j = 0; j < t; ++j)
+            vals[(size_t)(1 + k + j)] = (uint64_t)rands[(size_t)(b * t + j)];
+        for (int32_t i = 0; i < n_shares; ++i) {
+            const int64_t* row = m_host + (size_t)i * m2;
+            unsigned __int128 acc = 0;
+            int cnt = 0;
+            for (int32_t j = 0; j < m2; ++j) {
+                acc += (unsigned __int128)(uint64_t)row[j] * vals[(size_t)j];
+                if (++cnt == 8) {  // 8 * (2^62-1)^2 < 2^127: fold early
+                    acc %= m;
+                    cnt = 0;
+                }
+            }
+            varint_append(clerk_payloads[(size_t)i],
+                          (int64_t)(uint64_t)(acc % m));
+        }
+    }
+    for (int32_t i = 0; i < n_shares; ++i) {
+        int rc = seal_blob(s, clerk_payloads[(size_t)i],
+                           clerk_pks + (size_t)i * 32,
+                           out + pos, out_cap - pos, &written);
+        if (rc) return rc;
+        out_lens[1 + i] = written;
+        pos += written;
+    }
+    return 0;
+}
+
+int sda_native_abi_version() { return 4; }
 
 }  // extern "C"

@@ -401,3 +401,35 @@ def test_cli_embedded_participation(httpd, tmp_path, capsys):
     for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
         sda(who, "clerk", "--once")
     assert sda("recipient", "aggregations", "reveal", agg_id) == "11 22 33 44"
+
+
+def test_cli_embedded_shamir_participation(httpd, tmp_path, capsys):
+    """`participate --embedded` over a packed-Shamir committee via REST."""
+    from sda_tpu import native
+    from sda_tpu.crypto import sodium
+
+    if not (sodium.available() and native.available()):
+        pytest.skip("libsodium or native library not present")
+    url = httpd.address
+
+    def sda(identity, *args):
+        rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity),
+                       *args])
+        assert rc == 0
+        return capsys.readouterr().out.strip()
+
+    for who in ("recipient",) + tuple(f"clerk-{i}" for i in range(8)):
+        sda(who, "agent", "create")
+        sda(who, "agent", "keys", "create")
+    sda("part", "agent", "create")
+    agg_id = sda(
+        "recipient", "aggregations", "create", "shamir-embedded",
+        "--dimension", "4", "--modulus", "433",
+        "--sharing", "shamir", "--shares", "8",
+    )
+    sda("recipient", "aggregations", "begin", agg_id)
+    sda("part", "participate", agg_id, "1", "2", "3", "4", "--embedded")
+    sda("recipient", "aggregations", "end", agg_id)
+    for who in ("recipient",) + tuple(f"clerk-{i}" for i in range(8)):
+        sda(who, "clerk", "--once")
+    assert sda("recipient", "aggregations", "reveal", agg_id) == "1 2 3 4"

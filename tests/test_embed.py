@@ -132,23 +132,55 @@ def test_embedded_canonicalizes_negative_and_large_inputs():
            python_inputs=[[1, 1, 1, 1, 1]])
 
 
-def test_embedded_rejects_shamir_committee():
+def _shamir_round(sharing, masking, embedded_input, python_inputs,
+                  n_clerks=8):
+    """A Shamir-committee round with one C-core participation: the share
+    matrix is computed host-side, evaluated in C, and the Python clerks/
+    recipient must reconstruct the exact sum (the golden full_loop.rs
+    PackedShamir config at p=433, omega=354/150)."""
     service = new_memory_server()
     recipient = _client(service)
     rkey = recipient.new_encryption_key()
     recipient.upload_encryption_key(rkey)
-    agg = _agg(NoMasking()).replace(
+    agg = _agg(masking).replace(
         recipient=recipient.agent.id, recipient_key=rkey,
-        committee_sharing_scheme=PackedShamirSharing(3, 8, 4, MOD, 354, 150),
-        vector_dimension=DIM,
+        committee_sharing_scheme=sharing,
     )
     recipient.upload_aggregation(agg)
-    for _ in range(8):
-        c = _client(service)
+    clerks = [_client(service) for _ in range(n_clerks)]
+    for c in clerks:
         c.upload_encryption_key(c.new_encryption_key())
     recipient.begin_aggregation(agg.id)
-    with pytest.raises(ValueError, match="additive sharing only"):
-        new_participation_embedded(_client(service), [1] * DIM, agg.id)
+    participate_embedded(_client(service), embedded_input, agg.id)
+    for vals in python_inputs:
+        _client(service).participate(vals, agg.id)
+    recipient.end_aggregation(agg.id)
+    recipient.run_chores(-1)
+    for c in clerks:
+        c.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    expected = (np.asarray([embedded_input] + list(python_inputs))
+                .sum(axis=0) % MOD)
+    np.testing.assert_array_equal(out, expected)
+
+
+@pytest.mark.parametrize("masking", [
+    NoMasking(), FullMasking(MOD), ChaChaMasking(MOD, DIM, 128),
+], ids=["none", "full", "chacha"])
+def test_embedded_packed_shamir_reveals_exact(masking):
+    _shamir_round(PackedShamirSharing(3, 8, 4, MOD, 354, 150), masking,
+                  embedded_input=[1, 2, 3, 4, 5],
+                  python_inputs=[[10, 20, 30, 40, 50]])
+
+
+def test_embedded_basic_shamir_reveals_exact():
+    from sda_tpu.protocol import BasicShamirSharing
+
+    _shamir_round(BasicShamirSharing(share_count=8, privacy_threshold=3,
+                                     prime_modulus=MOD),
+                  FullMasking(MOD),
+                  embedded_input=[7, 0, 432, 1, 2],
+                  python_inputs=[[3, 3, 3, 3, 3], [5, 4, 3, 2, 1]])
 
 
 def test_embed_core_blob_shapes():
@@ -194,3 +226,36 @@ def test_embedded_rejects_scheme_modulus_drift():
     recipient.begin_aggregation(agg.id)
     with pytest.raises(ValueError, match="sharing modulus"):
         new_participation_embedded(_client(service), [1] * DIM, agg.id)
+
+
+def test_embedded_shamir_two_ring_masking():
+    """The production ring split: Shamir shares over a ~2^29 NTT prime,
+    masks over the aggregation modulus 433 (the CLI's capacity-headroom
+    policy) — the embedded participation must still reveal exactly."""
+    from sda_tpu.fields import numtheory
+
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    sharing = PackedShamirSharing(3, 8, t, p, w2, w3)
+    service = new_memory_server()
+    recipient = _client(service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    agg = _agg(FullMasking(MOD)).replace(
+        recipient=recipient.agent.id, recipient_key=rkey,
+        committee_sharing_scheme=sharing,
+    )
+    recipient.upload_aggregation(agg)
+    clerks = [_client(service) for _ in range(8)]
+    for c in clerks:
+        c.upload_encryption_key(c.new_encryption_key())
+    recipient.begin_aggregation(agg.id)
+    participate_embedded(_client(service), [1, 2, 3, 4, 5], agg.id)
+    _client(service).participate([100, 200, 300, 400, 430], agg.id)
+    recipient.end_aggregation(agg.id)
+    recipient.run_chores(-1)
+    for c in clerks:
+        c.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    np.testing.assert_array_equal(
+        out, (np.asarray([[1, 2, 3, 4, 5], [100, 200, 300, 400, 430]])
+              .sum(axis=0) % MOD))
