@@ -16,8 +16,9 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu bash docs/walkthrough.sh | tail -1
   [ "$reveal" = "0 2 2 4 4 6 6 8 8 10" ] || { echo "walkthrough output mismatch"; exit 1; }
 }
 
-echo "== examples (protocol-over-REST + streamed checkpoint/resume)"
+echo "== examples (protocol-over-REST + streamed checkpoint/resume + embedded)"
 python examples/federated_http.py
 python examples/streamed_checkpoint.py
+python examples/embedded_participant.py
 
 echo "CI OK"

@@ -5,10 +5,12 @@ C-friendly" API for mobile/embedded apps (reference README.md:196-204 —
 announced, never released into the repo). This module is the TPU build's
 analog, split the same way the reference intended:
 
-- ALL participant crypto (canonicalize -> mask -> additive-share ->
-  varint -> sealed boxes) runs in the native C core
-  (``sda_tpu.native.embed_participate`` / C ABI ``sda_embed_participate``
-  in native/src/sda_native.cpp) — the part an embedded app links;
+- ALL participant crypto (canonicalize -> mask -> share -> varint ->
+  sealed boxes) runs in the native C core
+  (``sda_tpu.native.embed_participate``, dispatching to the C ABI
+  ``sda_embed_participate`` for additive committees and
+  ``sda_embed_participate_shamir`` for packed-/BasicShamir ones, with
+  the share matrix computed host-side) — the part an embedded app links;
 - service interaction (fetching the aggregation/committee, verifying key
   signatures, uploading) stays host-side — here the Python client, in an
   app whatever HTTP stack it already has.

@@ -455,6 +455,23 @@ static void varint_append(std::vector<uint8_t>& out, int64_t x) {
     } while (u);
 }
 
+// (row . vals) mod m with u128 accumulation; entries canonical < m < 2^62.
+// The fold cadence keeps partials exact: 8 products of (2^62-1)^2 plus a
+// carried residue stay under 2^127 — the ONE place this invariant lives.
+static uint64_t moddot_row(const int64_t* row, const uint64_t* vals,
+                           int32_t n, uint64_t m) {
+    unsigned __int128 acc = 0;
+    int cnt = 0;
+    for (int32_t j = 0; j < n; ++j) {
+        acc += (unsigned __int128)(uint64_t)row[j] * vals[j];
+        if (++cnt == 8) {
+            acc %= m;
+            cnt = 0;
+        }
+    }
+    return (uint64_t)(acc % m);
+}
+
 static int seal_blob(Sodium& s, const std::vector<uint8_t>& msg,
                      const uint8_t* pk, uint8_t* out, int64_t cap,
                      int64_t* written) {
@@ -535,9 +552,10 @@ extern "C" {
 //   out_lens       int64[1 + share_count]: recipient blob length (0 when
 //                  masking none), then each clerk blob length
 //
-// Sharing is additive (the mobile-participant scheme); Shamir committees
-// keep the Python/TPU client. Returns 0 ok, 1 libsodium unavailable,
-// 2 out_cap too small, 3 bad arguments, 4 sealing failure.
+// Sharing here is additive; Shamir committees use the sibling
+// sda_embed_participate_shamir below (host-computed share matrix).
+// Returns 0 ok, 1 libsodium unavailable, 2 out_cap too small,
+// 3 bad arguments, 4 sealing failure.
 int sda_embed_participate(
     const int64_t* secret, int64_t dim, int64_t modulus,
     int32_t share_count, int32_t masking_kind, int32_t seed_bits,
@@ -645,18 +663,10 @@ int sda_embed_participate_shamir(
         for (int32_t j = 0; j < t; ++j)
             vals[(size_t)(1 + k + j)] = (uint64_t)rands[(size_t)(b * t + j)];
         for (int32_t i = 0; i < n_shares; ++i) {
-            const int64_t* row = m_host + (size_t)i * m2;
-            unsigned __int128 acc = 0;
-            int cnt = 0;
-            for (int32_t j = 0; j < m2; ++j) {
-                acc += (unsigned __int128)(uint64_t)row[j] * vals[(size_t)j];
-                if (++cnt == 8) {  // 8 * (2^62-1)^2 < 2^127: fold early
-                    acc %= m;
-                    cnt = 0;
-                }
-            }
-            varint_append(clerk_payloads[(size_t)i],
-                          (int64_t)(uint64_t)(acc % m));
+            varint_append(
+                clerk_payloads[(size_t)i],
+                (int64_t)moddot_row(m_host + (size_t)i * m2, vals.data(),
+                                    m2, m));
         }
     }
     for (int32_t i = 0; i < n_shares; ++i) {
