@@ -610,25 +610,43 @@ def bench_embedded_core():
     secret = rng.integers(0, 1 << 20, size=dim).astype(np.int64)
     clerk_pks = [sodium.box_keypair()[0] for _ in range(shares)]
     rpk, _ = sodium.box_keypair()
-    results = {}
-    for masking in ("none", "full", "chacha"):
+
+    def timed(**kw):
         t0 = time.perf_counter()
         reps = 0
         while time.perf_counter() - t0 < 1.0:
             native.embed_participate(
-                secret, mod, shares, masking=masking, seed_bits=128,
-                recipient_pk=rpk, clerk_pks=clerk_pks)
+                secret, recipient_pk=rpk, seed_bits=128, **kw)
             reps += 1
         per = (time.perf_counter() - t0) / reps
-        results[masking] = {
+        return {
             "participation_ms": round(per * 1e3, 2),
             "elements_per_sec": round(dim / per, 1),
         }
+
+    results = {}
+    for masking in ("none", "full", "chacha"):
+        results[masking] = dict(timed(
+            modulus=mod, share_count=shares, masking=masking,
+            clerk_pks=clerk_pks), clerks=shares, sharing="additive")
+    # the Shamir variant at the flagship committee (8 clerks, k=3): the
+    # host-computed share matrix evaluated in C, full masking
+    from sda_tpu.fields import numtheory
+    from sda_tpu.protocol import PackedShamirSharing
+
+    t_, p_, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    s8 = PackedShamirSharing(3, 8, t_, p_, w2, w3)
+    pk8 = [sodium.box_keypair()[0] for _ in range(8)]
+    results["packed_shamir_full"] = dict(timed(
+        modulus=p_, share_count=8, masking="full", clerk_pks=pk8,
+        share_matrix=numtheory.share_matrix_for(s8), secret_count=3,
+        mask_modulus=p_), clerks=8, sharing="packed-shamir k=3")
     return {
         "config": "embedded-10k",
         "metric": f"embedded participant core, full participation build "
-                  f"({dim}-dim update, {shares} clerks, sealedboxes "
-                  f"included)",
+                  f"({dim}-dim update, sealedboxes included; headline = "
+                  f"additive {shares}-clerk full-mask — per_masking rows "
+                  f"carry their own committee)",
         "value": results["full"]["elements_per_sec"],
         "unit": "masked+shared+sealed elements/sec (single host core)",
         "platform": "host",

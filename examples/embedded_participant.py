@@ -41,8 +41,11 @@ from sda_tpu.server import new_memory_server
 DIM, MOD = 8, 433
 
 if not (sodium.available() and native.available()):
-    print("libsodium or a C++ toolchain is unavailable; nothing to demo")
-    raise SystemExit(0)
+    # loud on purpose: in CI this image HAS the toolchain, so an
+    # unavailable native core is a build regression, not an environment
+    print("error: libsodium or the native build is unavailable — the "
+          "embedded demo cannot run", file=sys.stderr)
+    raise SystemExit(1)
 
 service = new_memory_server()
 
