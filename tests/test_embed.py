@@ -259,3 +259,67 @@ def test_embedded_shamir_two_ring_masking():
     np.testing.assert_array_equal(
         out, (np.asarray([[1, 2, 3, 4, 5], [100, 200, 300, 400, 430]])
               .sum(axis=0) % MOD))
+
+
+def test_embed_blobs_decode_to_telescoping_shares():
+    """Wire-level check below the protocol: decrypt every C-built clerk
+    blob with the clerk's secret key, varint-decode, and verify the share
+    vectors telescope to the canonical secret (additive) — the exact
+    parsing path the Python clerks run."""
+    from sda_tpu.crypto import varint
+
+    secret = [5, -3, 432, 1000, 0]
+    n = 4
+    keys = [sodium.box_keypair() for _ in range(n)]
+    rec, blobs = native.embed_participate(
+        secret, MOD, n, masking="none",
+        clerk_pks=[pk for pk, _ in keys])
+    assert rec is None
+    decoded = []
+    for (pk, sk), blob in zip(keys, blobs):
+        decoded.append(varint.decode(sodium.seal_open(blob, pk, sk)))
+    total = np.sum(decoded, axis=0) % MOD
+    np.testing.assert_array_equal(
+        total, np.asarray(secret, dtype=np.int64) % MOD)
+    for share in decoded:  # canonical residues on the wire
+        assert share.min() >= 0 and share.max() < MOD
+
+
+def test_embed_full_mask_blob_decodes_and_cancels():
+    """Recipient blob = varint(mask); clerk shares telescope to the
+    MASKED secret; mask subtraction recovers the canonical input."""
+    from sda_tpu.crypto import varint
+
+    secret = [1, 2, 3]
+    n = 3
+    keys = [sodium.box_keypair() for _ in range(n)]
+    rpk, rsk = sodium.box_keypair()
+    rec, blobs = native.embed_participate(
+        secret, MOD, n, masking="full", recipient_pk=rpk,
+        clerk_pks=[pk for pk, _ in keys])
+    mask = varint.decode(sodium.seal_open(rec, rpk, rsk))
+    shares = [varint.decode(sodium.seal_open(b, pk, sk))
+              for (pk, sk), b in zip(keys, blobs)]
+    masked = np.sum(shares, axis=0) % MOD
+    np.testing.assert_array_equal(
+        (masked - mask) % MOD, np.asarray(secret) % MOD)
+
+
+def test_embed_wrapper_validation_errors():
+    pks = [sodium.box_keypair()[0] for _ in range(3)]
+    with pytest.raises(ValueError, match="masking must be one of"):
+        native.embed_participate([1], MOD, 3, masking="bogus",
+                                 clerk_pks=pks)
+    with pytest.raises(ValueError, match="one clerk public key"):
+        native.embed_participate([1], MOD, 3, clerk_pks=pks[:2])
+    with pytest.raises(ValueError, match="32 bytes"):
+        native.embed_participate([1], MOD, 3, masking="full",
+                                 recipient_pk=b"x" * 31, clerk_pks=pks)
+    with pytest.raises(ValueError, match="share_matrix must be"):
+        native.embed_participate(
+            [1], MOD, 3, clerk_pks=pks,
+            share_matrix=np.zeros((2, 5), dtype=np.int64), secret_count=1)
+    with pytest.raises(ValueError, match="secret_count"):
+        native.embed_participate(
+            [1], MOD, 3, clerk_pks=pks,
+            share_matrix=np.zeros((3, 5), dtype=np.int64), secret_count=0)
