@@ -39,11 +39,11 @@ pytestmark = pytest.mark.skipif(
 DIM, MOD = 5, 433
 
 
-def _agg(masking) -> Aggregation:
+def _agg(masking, dim=DIM) -> Aggregation:
     return Aggregation(
         id=AggregationId.random(),
         title="embedded",
-        vector_dimension=DIM,
+        vector_dimension=dim,
         modulus=MOD,
         recipient=AgentId.random(),
         recipient_key=EncryptionKeyId.random(),
@@ -133,16 +133,16 @@ def test_embedded_canonicalizes_negative_and_large_inputs():
 
 
 def _shamir_round(sharing, masking, embedded_input, python_inputs,
-                  n_clerks=8):
-    """A Shamir-committee round with one C-core participation: the share
-    matrix is computed host-side, evaluated in C, and the Python clerks/
-    recipient must reconstruct the exact sum (the golden full_loop.rs
-    PackedShamir config at p=433, omega=354/150)."""
+                  n_clerks=8, dim=DIM):
+    """A committee round with one C-core participation: the share matrix
+    (when Shamir) is computed host-side, evaluated in C, and the Python
+    clerks/recipient must reconstruct the exact sum (the golden
+    full_loop.rs PackedShamir config at p=433, omega=354/150)."""
     service = new_memory_server()
     recipient = _client(service)
     rkey = recipient.new_encryption_key()
     recipient.upload_encryption_key(rkey)
-    agg = _agg(masking).replace(
+    agg = _agg(masking, dim=dim).replace(
         recipient=recipient.agent.id, recipient_key=rkey,
         committee_sharing_scheme=sharing,
     )
@@ -323,3 +323,31 @@ def test_embed_wrapper_validation_errors():
         native.embed_participate(
             [1], MOD, 3, clerk_pks=pks,
             share_matrix=np.zeros((3, 5), dtype=np.int64), secret_count=0)
+
+
+def test_embedded_randomized_config_sweep():
+    """Property sweep: random dims/committees/schemes/maskings — every
+    embedded participation must reveal exactly next to a Python one."""
+    from sda_tpu.protocol import BasicShamirSharing
+
+    rng = np.random.default_rng(2026)
+    for trial in range(6):
+        dim = int(rng.integers(1, 40))
+        scheme_pick = trial % 3
+        if scheme_pick == 0:
+            n = int(rng.integers(2, 6))
+            sharing = AdditiveSharing(share_count=n, modulus=MOD)
+        elif scheme_pick == 1:
+            sharing = PackedShamirSharing(3, 8, 4, MOD, 354, 150)
+            n = 8
+        else:
+            t = int(rng.integers(1, 4))
+            sharing = BasicShamirSharing(share_count=8,
+                                         privacy_threshold=t,
+                                         prime_modulus=MOD)
+            n = 8
+        masking = [NoMasking(), FullMasking(MOD),
+                   ChaChaMasking(MOD, dim, 128)][int(rng.integers(0, 3))]
+        emb = rng.integers(0, MOD, size=dim).tolist()
+        py = rng.integers(0, MOD, size=dim).tolist()
+        _shamir_round(sharing, masking, emb, [py], n_clerks=n, dim=dim)
