@@ -518,3 +518,25 @@ def test_uniform_tail_checkpoint_resume_and_fingerprint(tmp_path):
                                   checkpoint_every_chunks=1)
     assert not fresh.last_resumed  # foreign snapshot rejected, clean round
     np.testing.assert_array_equal(out2, ref)
+
+
+def test_uniform_tail_pallas_streamed_exact():
+    """uniform_tail + the PALLAS streamed stage — the exact combination
+    the TPU suite runs (interpret-mode kernel, external bits): ragged
+    tails on both axes pad to the chunk and the aggregate stays exact,
+    with one compiled step shape."""
+    from util import external_bits
+
+    scheme = fast_scheme()
+    p = scheme.prime_modulus
+    rng = np.random.default_rng(91)
+    P, d, pc, dc = 10, 100, 4, 36  # ragged on both axes
+    x = rng.integers(0, 1 << 16, size=(P, d))
+    agg = StreamingAggregator(
+        scheme, FullMasking(p), participants_chunk=pc, dim_chunk=dc,
+        use_pallas=True, pallas_interpret=True,
+        pallas_external_bits_fn=external_bits, uniform_tail=True)
+    assert agg.pallas_active
+    out = agg.aggregate(x, key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(out, x.sum(axis=0) % p)
+    assert len(agg._steps) == 1, list(agg._steps)
