@@ -60,6 +60,25 @@ def _emit(stage: str, **kw) -> None:
 # ---------------------------------------------------------------------------
 # Parametric probe kernel (mirrors fused_mask_share_combine's structure)
 
+def solve_budget(secs: dict) -> dict:
+    """Solve the component system from the four variant timings (seconds).
+
+    Every variant pays the grid/init/loop overhead O once:
+        fold_only = O+F, prng_only = O+R, no_matmul = O+F+R,
+        full = O+F+R+M
+    => M = full - no_matmul, R = no_matmul - fold_only,
+       O = prng_only - R, F = fold_only - O. Pure host math, unit-tested
+    off-chip (tests/test_kernel_probe_budget.py) so a scarce window's
+    budget line can't be wrong by algebra.
+    """
+    matmul_s = secs["full"] - secs["no_matmul"]
+    prng_s = secs["no_matmul"] - secs["fold_only"]
+    overhead_s = secs["prng_only"] - prng_s
+    fold_s = secs["fold_only"] - overhead_s
+    return {"fold_s": fold_s, "prng_s": prng_s, "matmul_s": matmul_s,
+            "overhead_s": overhead_s}
+
+
 def probe_call(x_cols, seed, sp, m_host, t, *, do_x, do_prng, do_matmul,
                tile, p_block, p_tile, tree=False, interpret=False):
     """Variant of the fused kernel running only the selected components.
@@ -437,18 +456,12 @@ def main() -> int:
                   fold_tree_ms=round(secs["fold_tree"] * 1e3, 3),
                   bit_identical=same)
             ok = ok and same
-        # every variant pays the grid/init/loop overhead O once:
-        #   fold_only = O+F, prng_only = O+R, no_matmul = O+F+R,
-        #   full = O+F+R+M  =>  solve for the four components
-        matmul_s = secs["full"] - secs["no_matmul"]
-        prng_s = secs["no_matmul"] - secs["fold_only"]
-        overhead_s = secs["prng_only"] - prng_s
-        fold_s = secs["fold_only"] - overhead_s
+        b = solve_budget(secs)
         _emit("budget",
-              fold_ms=round(fold_s * 1e3, 3),
-              prng_ms=round(prng_s * 1e3, 3),
-              matmul_ms=round(matmul_s * 1e3, 3),
-              overhead_ms=round(overhead_s * 1e3, 3),
+              fold_ms=round(b["fold_s"] * 1e3, 3),
+              prng_ms=round(b["prng_s"] * 1e3, 3),
+              matmul_ms=round(b["matmul_s"] * 1e3, 3),
+              overhead_ms=round(b["overhead_s"] * 1e3, 3),
               full_ms=round(secs["full"] * 1e3, 3),
               full_el_per_s=round(elements / secs["full"], 1))
 

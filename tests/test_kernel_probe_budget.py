@@ -1,0 +1,44 @@
+"""The kernel probe's component-budget algebra (pure host math).
+
+The on-chip probe times four kernel variants and solves for the
+fold/PRNG/matmul/overhead components; the solve must invert the
+generative model exactly, or a scarce hardware window publishes a wrong
+attribution (the round-4 review caught a sign error in an earlier
+formulation — this pins the fixed one).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+from kernel_probe import solve_budget  # noqa: E402
+
+
+def _timings(O, F, R, M):
+    return {
+        "fold_only": O + F,
+        "prng_only": O + R,
+        "no_matmul": O + F + R,
+        "full": O + F + R + M,
+    }
+
+
+@pytest.mark.parametrize("O,F,R,M", [
+    (0.002, 0.010, 0.006, 0.001),
+    (0.0, 0.5, 0.25, 0.125),
+    (0.01, 0.0, 0.0, 0.0),     # pure overhead
+    (0.0005, 0.03, 0.001, 0.02),
+])
+def test_solve_inverts_generative_model(O, F, R, M):
+    # each component asserted against its independently-known generative
+    # value — the only form that catches a sign/term error (a components-
+    # sum-to-full check telescopes to a tautology for ANY overhead formula)
+    got = solve_budget(_timings(O, F, R, M))
+    assert got["overhead_s"] == pytest.approx(O)
+    assert got["fold_s"] == pytest.approx(F)
+    assert got["prng_s"] == pytest.approx(R)
+    assert got["matmul_s"] == pytest.approx(M)
