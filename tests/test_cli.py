@@ -433,3 +433,36 @@ def test_cli_embedded_shamir_participation(httpd, tmp_path, capsys):
     for who in ("recipient",) + tuple(f"clerk-{i}" for i in range(8)):
         sda(who, "clerk", "--once")
     assert sda("recipient", "aggregations", "reveal", agg_id) == "1 2 3 4"
+
+
+def test_cli_embedded_rejects_paillier_cleanly(httpd, tmp_path, capsys):
+    """`participate --embedded` on a Paillier aggregation: clear error,
+    exit 1, no traceback (the embedded core is Sodium-only)."""
+    from sda_tpu import native
+    from sda_tpu.crypto import sodium
+
+    if not (sodium.available() and native.available()):
+        pytest.skip("libsodium or native library not present")
+    url = httpd.address
+
+    def sda(identity, *args, expect_rc=0):
+        rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity),
+                       *args])
+        assert rc == expect_rc
+        return capsys.readouterr()
+
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "agent", "create")
+        sda(who, "agent", "keys", "create",
+            "--encryption", "paillier", "--paillier-modulus-bits", "512")
+    sda("part", "agent", "create")
+    agg_id = sda(
+        "recipient", "aggregations", "create", "paillier-round",
+        "--dimension", "4", "--modulus", "433", "--shares", "3",
+        "--encryption", "paillier", "--paillier-modulus-bits", "512",
+    ).out.strip()
+    sda("recipient", "aggregations", "begin", agg_id)
+    captured = sda("part", "participate", agg_id, "1", "2", "3", "4",
+                   "--embedded", expect_rc=1)
+    assert "embedded participation failed" in captured.err
+    assert "Sodium" in captured.err
