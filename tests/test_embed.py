@@ -351,3 +351,29 @@ def test_embedded_randomized_config_sweep():
         emb = rng.integers(0, MOD, size=dim).tolist()
         py = rng.integers(0, MOD, size=dim).tolist()
         _shamir_round(sharing, masking, emb, [py], n_clerks=n, dim=dim)
+
+
+def test_embedded_near_64bit_modulus():
+    """Edge coverage at a huge ring (just below the 2^62 share bound):
+    uniform rejection sampling's acceptance zone, 9-10-byte varints, and
+    the output-capacity sizing all get exercised; the round must reveal
+    exactly against Python clerks."""
+    # 2^61-1: additive sharing only needs a ring modulus (primality
+    # unused), and it sits just under the core's 2^62 share bound
+    big = (1 << 61) - 1
+    from sda_tpu.crypto import varint
+
+    n = 3
+    keys = [sodium.box_keypair() for _ in range(n)]
+    secret = [0, 1, big - 1, 123456789012345678]
+    rec, blobs = native.embed_participate(
+        secret, big, n, masking="none",
+        clerk_pks=[pk for pk, _ in keys])
+    decoded = [varint.decode(sodium.seal_open(b, pk, sk))
+               for (pk, sk), b in zip(keys, blobs)]
+    # telescoping mod big, computed in Python ints to avoid i64 overflow
+    total = [(sum(int(s[i]) for s in decoded)) % big
+             for i in range(len(secret))]
+    assert total == [v % big for v in secret]
+    for share in decoded:
+        assert share.min() >= 0 and int(share.max()) < big
