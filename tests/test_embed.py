@@ -358,22 +358,30 @@ def test_embedded_near_64bit_modulus():
     uniform rejection sampling's acceptance zone, 9-10-byte varints, and
     the output-capacity sizing all get exercised; the round must reveal
     exactly against Python clerks."""
-    # 2^61-1: additive sharing only needs a ring modulus (primality
-    # unused), and it sits just under the core's 2^62 share bound
-    big = (1 << 61) - 1
+    # 2^63-1: the largest ring an i64 share can carry (additive sharing
+    # only needs a ring modulus; primality unused). Shares >= 2^62 zigzag
+    # to TEN-byte varints, exercising the encoder's widest path and
+    # varint.decode's 10th-byte overflow guard
+    big = (1 << 63) - 1
     from sda_tpu.crypto import varint
 
     n = 3
+    dim = 32  # enough draws that some share >= 2^62 w.p. 1 - 2^-64
     keys = [sodium.box_keypair() for _ in range(n)]
-    secret = [0, 1, big - 1, 123456789012345678]
+    secret = [0, 1, big - 1, 123456789012345678] + list(range(dim - 4))
     rec, blobs = native.embed_participate(
         secret, big, n, masking="none",
         clerk_pks=[pk for pk, _ in keys])
+    assert rec is None  # masking none: no recipient blob, large ring or not
     decoded = [varint.decode(sodium.seal_open(b, pk, sk))
                for (pk, sk), b in zip(keys, blobs)]
     # telescoping mod big, computed in Python ints to avoid i64 overflow
     total = [(sum(int(s[i]) for s in decoded)) % big
              for i in range(len(secret))]
     assert total == [v % big for v in secret]
+    widest = 0
     for share in decoded:
         assert share.min() >= 0 and int(share.max()) < big
+        widest = max(widest, int(share.max()))
+    # the 10-byte varint path actually ran
+    assert widest >= (1 << 62)
