@@ -115,7 +115,15 @@ def new_participation_embedded(
         kind, seed_bits = "none", 0
     elif isinstance(masking, (FullMasking, ChaChaMasking)):
         if isinstance(masking, ChaChaMasking):
-            kind, seed_bits = "chacha", masking.seed_bitsize
+            # native masking kind tracks the scheme's PRG tag: the default
+            # rand-0.3 stream (kind 3) keeps embedded participations
+            # interoperable with Rust peers; V1 (kind 2) is the tagged
+            # TPU-native opt-in. Unknown tags already failed in the scheme
+            # constructor.
+            from ..protocol import CHACHA_PRG_V1
+
+            kind = "chacha" if masking.prg == CHACHA_PRG_V1 else "chacha_rand03"
+            seed_bits = masking.seed_bitsize
             if masking.dimension != aggregation.vector_dimension:
                 raise ValueError(
                     f"ChaCha masking dimension {masking.dimension} != "

@@ -9,8 +9,10 @@ reconstructed combined masked secrets.
 - Full (full.rs): per-element fresh uniform mask, uploaded in full — here
   generated on-device by threefry.
 - ChaCha (chacha.rs): the uploaded "mask" is the PRG *seed* (u32 words,
-  serialized as i64s); both sides expand it with the ChaCha20 PRG
-  (sda_tpu.fields.chacha — versioned spec CHACHA_PRG_V1).
+  serialized as i64s); both sides expand it with the scheme's tagged
+  ChaCha20 PRG (sda_tpu.fields.chacha) — the default CHACHA_PRG_RAND03 is
+  the exact rand-0.3 ChaChaRng stream the reference draws (rand-0.3 wire
+  interop), CHACHA_PRG_V1 the TPU-native opt-in spec.
 """
 
 from __future__ import annotations
@@ -90,10 +92,14 @@ class FullMasker(SecretMasker, MaskCombiner, SecretUnmasker):
 
 
 class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
-    def __init__(self, modulus: int, dimension: int, seed_bitsize: int):
+    def __init__(self, modulus: int, dimension: int, seed_bitsize: int,
+                 prg: str = chacha.CHACHA_PRG_RAND03):
+        if prg not in chacha._EXPANDERS:  # defense in depth vs the scheme
+            raise ValueError(f"unknown ChaCha PRG {prg!r}")
         self.modulus = modulus
         self.dimension = dimension
         self.seed_bitsize = seed_bitsize
+        self.prg = prg
 
     @staticmethod
     def _device_backend() -> bool:
@@ -110,10 +116,14 @@ class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
         if self._device_backend():
             from ..fields import chacha_jax
 
-            return chacha_jax.expand_mask(seed, self.dimension, self.modulus)
+            return chacha_jax.expand_mask(
+                seed, self.dimension, self.modulus, prg=self.prg
+            )
         if native.available():
-            return native.chacha_expand_mask(seed, self.dimension, self.modulus)
-        return chacha.expand_mask(seed, self.dimension, self.modulus)
+            return native.chacha_expand_mask(
+                seed, self.dimension, self.modulus, prg=self.prg
+            )
+        return chacha.expand_mask_for(self.prg, seed, self.dimension, self.modulus)
 
     def mask(self, secrets):
         secrets = np.asarray(secrets, dtype=np.int64)
@@ -136,14 +146,17 @@ class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
             from ..fields import chacha_jax
 
             return chacha_jax.combine_masks(
-                [[int(w) for w in s] for s in stacked], self.dimension, self.modulus
+                [[int(w) for w in s] for s in stacked], self.dimension,
+                self.modulus, prg=self.prg,
             )
         if native.available():
-            return native.chacha_combine_masks(stacked, self.dimension, self.modulus)
+            return native.chacha_combine_masks(
+                stacked, self.dimension, self.modulus, prg=self.prg
+            )
         result = np.zeros(self.dimension, dtype=np.int64)
         for seed in stacked:
-            expanded = chacha.expand_mask(
-                [int(w) for w in seed], self.dimension, self.modulus
+            expanded = chacha.expand_mask_for(
+                self.prg, [int(w) for w in seed], self.dimension, self.modulus
             )
             result = (result + expanded) % self.modulus
         return result
@@ -170,5 +183,8 @@ def _dispatch(scheme: LinearMaskingScheme):
     if isinstance(scheme, FullMasking):
         return FullMasker(scheme.modulus)
     if isinstance(scheme, ChaChaMasking):
-        return ChaChaMasker(scheme.modulus, scheme.dimension, scheme.seed_bitsize)
+        return ChaChaMasker(
+            scheme.modulus, scheme.dimension, scheme.seed_bitsize,
+            prg=scheme.prg,
+        )
     raise ValueError(f"unknown masking scheme {scheme!r}")

@@ -1,23 +1,33 @@
-"""ChaCha20-based deterministic mask PRG (host-side, vectorized numpy).
+"""ChaCha20-based deterministic mask PRGs (host-side, vectorized numpy).
 
 The reference's ChaCha masking scheme derives an O(d) mask from a <=256-bit
 seed so participants upload O(1) mask data (client/src/crypto/masking/
-chacha.rs:24-77, via rand 0.3's ChaChaRng). The exact rand-0.3 stream is not
-reproduced here; sda-tpu pins its own versioned PRG spec (``CHACHA_PRG_V1``)
-with the same interface and security properties:
+chacha.rs:24-77, via rand 0.3's ChaChaRng). TWO streams are implemented over
+the shared ChaCha20 block function, selected by the wire-visible ``prg`` tag
+on the scheme (protocol.crypto.ChaChaMasking):
 
-- seed: list of u32 words (serialized as the i64 "mask" vector on the wire,
-  chacha.rs:49-53 convention);
-- key: seed words placed in key words 0..len-1, remaining words 0;
-- state: RFC-7539 constants | key(8) | block counter (word 12, from 0) |
-  words 13..15 zero; 20 rounds; output words little-endian;
-- draw stream: consecutive u64 = (word[2i] as low, word[2i+1] as high);
-- sample in [0, m): rejection below zone = floor(2^64/m)*m, then v % m.
+``CHACHA_PRG_RAND03`` (the default — what the bare Rust wire shape means):
+the exact rand-0.3 ``ChaChaRng::from_seed(&[u32])`` + ``gen_range(0, m)``
+stream the reference's masker draws, so a round mixed with a Rust peer
+reveals the CORRECT aggregate. Per rand 0.3's chacha.rs and
+distributions/range.rs:
+
+- key: seed words into key words 0..len-1, remaining words 0; block counter
+  is 128-bit (words 12..15, from 0) — identical to a word-12 counter below
+  2^32 blocks; 20 rounds;
+- draw stream: ``next_u64`` = (FIRST word as high) << 32 | (second as low);
+- sample in [0, m): accept v < zone where zone = u64::MAX - u64::MAX % m,
+  then v % m.
+
+``CHACHA_PRG_V1`` (opt-in, tagged on the wire): sda-tpu's own versioned
+spec — same block function, but u64 draws take word[2i] as the LOW half and
+the acceptance zone is floor(2^64/m)*m (inclusive-below), which also
+differs from rand 0.3 on power-of-two moduli.
 
 Both participant (mask generation) and recipient (mask re-expansion — the
-recipient hot loop, receive.rs:102-118) use this expansion, so the protocol
-stays self-consistent; a native C++ implementation of the same spec lives in
-sda_tpu/native.
+recipient hot loop, receive.rs:102-118) use the same expansion, so the
+protocol stays self-consistent; native C++ implementations of both specs
+live in sda_tpu/native, device (jax) implementations in fields.chacha_jax.
 """
 
 from __future__ import annotations
@@ -28,6 +38,11 @@ from typing import List, Sequence
 import numpy as np
 
 CHACHA_PRG_V1 = "sda-tpu/chacha20-prg/v1"
+#: the stream implied by the bare Rust wire shape (crypto.rs:53 documents
+#: the scheme as `rand::chacha::ChaChaRng`); protocol.crypto pins the same
+#: literals (duplicated to keep the wire layer import-free; a test asserts
+#: they match)
+CHACHA_PRG_RAND03 = "rand-0.3/chacharng"
 
 _CONSTANTS = np.array(
     [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574], dtype=np.uint32
@@ -117,3 +132,55 @@ def expand_mask(seed: Sequence[int], dimension: int, modulus: int) -> np.ndarray
         out[filled : filled + take] = (v[:take] % m).astype(np.int64)
         filled += take
     return out
+
+
+def expand_mask_rand03(seed: Sequence[int], dimension: int, modulus: int) -> np.ndarray:
+    """The exact rand-0.3 ChaChaRng mask stream (chacha.rs:37-41, 57-77).
+
+    ``ChaChaRng::from_seed(&seed)`` then ``gen_range(0_i64, modulus)`` per
+    element: u64 draws assemble the FIRST keystream word as the HIGH half
+    (rand 0.3's default ``Rng::next_u64``), rejection accepts
+    ``v < u64::MAX - u64::MAX % m`` (distributions/range.rs), result is
+    ``v % m``. Each rejected draw consumes its two words, so the word
+    pairing is positional and the expansion vectorizes exactly.
+    """
+    if modulus <= 0 or modulus >= (1 << 62):
+        raise ValueError("modulus out of range")
+    m = np.uint64(modulus)
+    u64_max = (1 << 64) - 1
+    zone_excl = np.uint64(u64_max - u64_max % modulus)  # accept v < zone
+    out = np.empty(dimension, dtype=np.int64)
+    filled = 0
+    counter = 0
+    while filled < dimension:
+        need = dimension - filled
+        nblocks = max(1, -(-need // 8) + 1)
+        words = chacha_block_words(seed, counter, nblocks).reshape(-1)
+        counter += nblocks
+        hi = words[0::2].astype(np.uint64)
+        lo = words[1::2].astype(np.uint64)
+        v = (hi << np.uint64(32)) | lo
+        v = v[v < zone_excl]
+        take = min(need, v.shape[0])
+        out[filled : filled + take] = (v[:take] % m).astype(np.int64)
+        filled += take
+    return out
+
+
+_EXPANDERS = {
+    CHACHA_PRG_V1: expand_mask,
+    CHACHA_PRG_RAND03: expand_mask_rand03,
+}
+
+
+def expand_mask_for(
+    prg: str, seed: Sequence[int], dimension: int, modulus: int
+) -> np.ndarray:
+    """PRG-tag-dispatched expansion; unknown tags fail loudly — an
+    unrecognized stream must never silently alias another one (that is
+    exactly the wrong-aggregate hazard the tag exists to prevent)."""
+    try:
+        fn = _EXPANDERS[prg]
+    except KeyError:
+        raise ValueError(f"unknown ChaCha PRG {prg!r}") from None
+    return fn(seed, dimension, modulus)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import chacha
 from .chacha import _CONSTANTS
 
 _U32 = jnp.uint32
@@ -73,18 +74,34 @@ def chacha_block_words(seed_words, counter0, *, nblocks: int):
     return jnp.stack(words, axis=1)  # [nblocks, 16]
 
 
-@functools.partial(jax.jit, static_argnames=("dimension", "modulus"))
-def _expand_no_reject(seed_words, *, dimension: int, modulus: int):
-    """(mask [dimension] int64, any_rejected bool) — fast path."""
+@functools.partial(jax.jit, static_argnames=("dimension", "modulus", "prg"))
+def _expand_no_reject(seed_words, *, dimension: int, modulus: int,
+                      prg: str = chacha.CHACHA_PRG_V1):
+    """(mask [dimension] int64, any_rejected bool) — fast path.
+
+    ``prg`` selects the stream: CHACHA_PRG_V1 (word[2i] = low half, zone
+    floor(2^64/m)*m inclusive-below) or CHACHA_PRG_RAND03 (rand 0.3's
+    next_u64: word[2i] = HIGH half, zone u64::MAX - u64::MAX % m
+    exclusive — see fields.chacha.expand_mask_rand03).
+    """
     # match the host oracle's first-iteration overdraw: ceil(d/8)+1 blocks
     nblocks = max(1, -(-dimension // 8) + 1)
     words = chacha_block_words(seed_words, 0, nblocks=nblocks).reshape(-1)
-    lo = words[0::2].astype(jnp.uint64)
-    hi = words[1::2].astype(jnp.uint64)
-    v = (hi << jnp.uint64(32)) | lo
-    zone = jnp.uint64(((1 << 64) // modulus) * modulus - 1)
-    first = v[:dimension]
-    any_rejected = jnp.any(first > zone)
+    even = words[0::2].astype(jnp.uint64)
+    odd = words[1::2].astype(jnp.uint64)
+    if prg == chacha.CHACHA_PRG_RAND03:
+        v = (even << jnp.uint64(32)) | odd
+        u64_max = (1 << 64) - 1
+        zone_excl = jnp.uint64(u64_max - u64_max % modulus)
+        first = v[:dimension]
+        any_rejected = jnp.any(first >= zone_excl)
+    elif prg == chacha.CHACHA_PRG_V1:
+        v = (odd << jnp.uint64(32)) | even
+        zone = jnp.uint64(((1 << 64) // modulus) * modulus - 1)
+        first = v[:dimension]
+        any_rejected = jnp.any(first > zone)
+    else:
+        raise ValueError(f"unknown ChaCha PRG {prg!r}")
     mask = jnp.mod(first, jnp.uint64(modulus)).astype(jnp.int64)
     return mask, any_rejected
 
@@ -139,22 +156,31 @@ def _modsum_i64(x, modulus: int, axis: int = 0):
     return x[0]
 
 
-@functools.partial(jax.jit, static_argnames=("dimension", "modulus"))
-def _combine_no_reject(seed_matrix, *, dimension: int, modulus: int):
+@functools.partial(jax.jit, static_argnames=("dimension", "modulus", "prg"))
+def _combine_no_reject(seed_matrix, *, dimension: int, modulus: int,
+                       prg: str = chacha.CHACHA_PRG_V1):
     """[S, 8] seeds -> (sum of masks mod m [dimension] int64, [S] rejected)."""
     masks, rejected = jax.vmap(
-        lambda sw: _expand_no_reject(sw, dimension=dimension, modulus=modulus)
+        lambda sw: _expand_no_reject(
+            sw, dimension=dimension, modulus=modulus, prg=prg
+        )
     )(seed_matrix)
     total = _modsum_i64(masks, modulus, axis=0)
     return total, rejected
 
 
-def combine_masks(seeds, dimension: int, modulus: int) -> np.ndarray:
+def combine_masks(
+    seeds, dimension: int, modulus: int, *, prg: str
+) -> np.ndarray:
     """Sum of all seeds' expanded masks mod m — the recipient hot loop
     (receive.rs:102-118), every seed's 20-round expansion in parallel lanes.
-    Bit-identical to summing chacha.expand_mask per seed."""
+    Bit-identical to summing the host expansion (``prg``-selected) per seed.
+    ``prg`` is required: a defaulted stream choice could silently expand the
+    wrong stream for a wire seed."""
     if modulus <= 0 or modulus >= (1 << 62):
         raise ValueError("modulus out of range")
+    if prg not in chacha._EXPANDERS:
+        raise ValueError(f"unknown ChaCha PRG {prg!r}")
     seed_matrix = np.zeros((len(seeds), 8), dtype=np.uint32)
     for i, seed in enumerate(seeds):
         if len(seed) > 8:
@@ -162,38 +188,41 @@ def combine_masks(seeds, dimension: int, modulus: int) -> np.ndarray:
         for j, w in enumerate(seed):
             seed_matrix[i, j] = np.uint32(int(w) & 0xFFFFFFFF)
     total, rejected = _combine_no_reject(
-        jnp.asarray(seed_matrix), dimension=dimension, modulus=modulus
+        jnp.asarray(seed_matrix), dimension=dimension, modulus=modulus, prg=prg
     )
     rejected = np.asarray(rejected)
     if rejected.any():  # replay the affected seeds exactly on the host
-        from . import chacha
-
         total = np.asarray(total, dtype=np.int64)
         for i in np.nonzero(rejected)[0]:
             seed = [int(w) for w in seeds[i]]
             wrong, _ = _expand_no_reject(
-                jnp.asarray(seed_matrix[i]), dimension=dimension, modulus=modulus
+                jnp.asarray(seed_matrix[i]), dimension=dimension,
+                modulus=modulus, prg=prg,
             )
-            right = chacha.expand_mask(seed, dimension, modulus)
+            right = chacha.expand_mask_for(prg, seed, dimension, modulus)
             total = (total - np.asarray(wrong) + right) % modulus
         return total
     return np.asarray(total)
 
 
-def expand_mask(seed: Sequence[int], dimension: int, modulus: int) -> np.ndarray:
-    """Drop-in device-accelerated chacha.expand_mask (bit-identical)."""
+def expand_mask(
+    seed: Sequence[int], dimension: int, modulus: int, *, prg: str
+) -> np.ndarray:
+    """Drop-in device-accelerated chacha.expand_mask / expand_mask_rand03
+    (bit-identical to the ``prg``-selected host expansion; ``prg`` required
+    for the same reason as combine_masks)."""
     if modulus <= 0 or modulus >= (1 << 62):
         raise ValueError("modulus out of range")
+    if prg not in chacha._EXPANDERS:
+        raise ValueError(f"unknown ChaCha PRG {prg!r}")
     if len(seed) > 8:
         raise ValueError("seed longer than 256 bits")
     seed_words = np.zeros(8, dtype=np.uint32)
     for i, w in enumerate(seed):
         seed_words[i] = np.uint32(w & 0xFFFFFFFF)
     mask, any_rejected = _expand_no_reject(
-        jnp.asarray(seed_words), dimension=dimension, modulus=modulus
+        jnp.asarray(seed_words), dimension=dimension, modulus=modulus, prg=prg
     )
     if bool(any_rejected):  # p < dimension * modulus / 2^64 — practically never
-        from . import chacha
-
-        return chacha.expand_mask(seed, dimension, modulus)
+        return chacha.expand_mask_for(prg, seed, dimension, modulus)
     return np.asarray(mask)

@@ -260,9 +260,12 @@ def _share_sum_stage(scheme, f: FieldOps, M_host, masked, skey):
 def _pallas_supported(scheme, masking, f: FieldOps) -> bool:
     """The fused kernel serves packed-Shamir over a Solinas prime with any
     masking in the lattice. None/Full draw inside the kernel; ChaCha masks
-    must come from the versioned wire PRG (CHACHA_PRG_V1), so they are
-    applied in a fused XLA pass FIRST and the kernel runs mask-free on the
-    pre-masked input — see _pallas_stage."""
+    are expanded from the CHACHA_PRG_V1 stream in a fused XLA pass FIRST
+    and the kernel runs mask-free on the pre-masked input — see
+    _pallas_stage. Pod-internal masks are generated AND cancelled inside
+    the round (never wire-visible), so this choice is independent of the
+    scheme's ``prg`` tag — any prg-tagged ChaChaMasking is accepted and
+    the aggregate is exact either way."""
     return (
         isinstance(scheme, SHAMIR_SCHEMES)
         and f.sp is not None
@@ -302,12 +305,15 @@ def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
     kernel's on-core PRNG (or injected external bits) never changes the
     aggregate; tests pin pallas-pod == xla-pod == plain sum.
 
-    ChaCha masking: the mask is the versioned wire PRG (CHACHA_PRG_V1), a
-    function of (round key, global participant id, dim offset) — it is
-    applied by the existing fused XLA _mask_stage pass first, and the
-    kernel then runs mask-free on the pre-masked input; ``round_key``/
-    ``pid_base``/``d_block0`` locate this tile in the global stream
-    exactly like the XLA path.
+    ChaCha masking: the mask is the CHACHA_PRG_V1 stream, a function of
+    (round key, global participant id, dim offset) — it is applied by the
+    existing fused XLA _mask_stage pass first, and the kernel then runs
+    mask-free on the pre-masked input; ``round_key``/``pid_base``/
+    ``d_block0`` locate this tile in the global stream exactly like the
+    XLA path. This is prg-tag-independent by the same cancellation
+    argument as above: pod masks never leave the round, so the scheme's
+    wire ``prg`` (default rand-0.3) only governs FEDERATED seed uploads,
+    which pod mode never produces.
 
     ``external_bits_fn(key, S, draws, B)`` (tests/util.external_bits
     layout) enables interpret-mode runs on CPU, where the TPU PRNG

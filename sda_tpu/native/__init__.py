@@ -19,7 +19,7 @@ import numpy as np
 _HERE = Path(__file__).parent
 _SRC = _HERE / "src" / "sda_native.cpp"
 _LIB_PATH = _HERE / "libsda_native.so"
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -70,7 +70,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.sda_modmatmul_i64.argtypes = [i64p, i64p, i64p] + [ctypes.c_int64] * 4
         lib.sda_modsum_axis0.argtypes = [i64p, i64p] + [ctypes.c_int64] * 3
         lib.sda_chacha_expand_mask.argtypes = [u32p] + [ctypes.c_int64] * 3 + [i64p]
+        lib.sda_chacha_expand_mask_r03.argtypes = (
+            [u32p] + [ctypes.c_int64] * 3 + [i64p]
+        )
         lib.sda_chacha_combine_masks.argtypes = (
+            [i64p] + [ctypes.c_int64] * 4 + [i64p, i64p]
+        )
+        lib.sda_chacha_combine_masks_r03.argtypes = (
             [i64p] + [ctypes.c_int64] * 4 + [i64p, i64p]
         )
         u64p = ctypes.POINTER(ctypes.c_uint64)
@@ -136,25 +142,43 @@ def modsum_axis0(x: np.ndarray, m: int) -> np.ndarray:
     return out
 
 
-def chacha_expand_mask(seed: Sequence[int], dim: int, modulus: int) -> np.ndarray:
+#: wire PRG tag -> native expand/combine symbol pair, keyed on the spec
+#: home's constants so a tag rename cannot drift past this map. ``prg`` is
+#: REQUIRED at this layer: a defaulted stream choice here could silently
+#: expand the wrong stream for a wire seed — the exact hazard the tag
+#: exists to prevent.
+from ..fields.chacha import CHACHA_PRG_RAND03, CHACHA_PRG_V1  # noqa: E402
+
+_CHACHA_FNS = {
+    CHACHA_PRG_V1: ("sda_chacha_expand_mask", "sda_chacha_combine_masks"),
+    CHACHA_PRG_RAND03: ("sda_chacha_expand_mask_r03",
+                        "sda_chacha_combine_masks_r03"),
+}
+
+
+def chacha_expand_mask(
+    seed: Sequence[int], dim: int, modulus: int, *, prg: str
+) -> np.ndarray:
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
     if not 0 < modulus < (1 << 62):  # same validation as the Python spec
         raise ValueError("modulus out of range")
+    if prg not in _CHACHA_FNS:
+        raise ValueError(f"unknown ChaCha PRG {prg!r}")
     seed_arr = np.asarray(list(seed), dtype=np.uint32)
     out = np.empty(dim, dtype=np.int64)
-    rc = lib.sda_chacha_expand_mask(
+    rc = getattr(lib, _CHACHA_FNS[prg][0])(
         seed_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         seed_arr.size, dim, modulus, _i64(out),
     )
     if rc:
-        raise ValueError("sda_chacha_expand_mask failed")
+        raise ValueError(f"{_CHACHA_FNS[prg][0]} failed")
     return out
 
 
 def chacha_combine_masks(
-    seeds: np.ndarray, dim: int, modulus: int
+    seeds: np.ndarray, dim: int, modulus: int, *, prg: str
 ) -> np.ndarray:
     """Sum of expanded masks for [n_seeds, seed_words] i64 seeds — the
     recipient hot loop in one native call."""
@@ -163,15 +187,17 @@ def chacha_combine_masks(
         raise RuntimeError("native library unavailable")
     if not 0 < modulus < (1 << 62):  # same validation as the Python spec
         raise ValueError("modulus out of range")
+    if prg not in _CHACHA_FNS:
+        raise ValueError(f"unknown ChaCha PRG {prg!r}")
     seeds = np.ascontiguousarray(seeds, dtype=np.int64)
     n_seeds, seed_words = seeds.shape
     scratch = np.empty(dim, dtype=np.int64)
     out = np.empty(dim, dtype=np.int64)
-    rc = lib.sda_chacha_combine_masks(
+    rc = getattr(lib, _CHACHA_FNS[prg][1])(
         _i64(seeds), n_seeds, seed_words, dim, modulus, _i64(scratch), _i64(out)
     )
     if rc:
-        raise ValueError("sda_chacha_combine_masks failed")
+        raise ValueError(f"{_CHACHA_FNS[prg][1]} failed")
     return out
 
 
@@ -207,7 +233,7 @@ def powmod(base: int, exp: int, mod: int) -> int:
     return int.from_bytes(out.tobytes(), "little")
 
 
-_MASKING_KIND = {"none": 0, "full": 1, "chacha": 2}
+_MASKING_KIND = {"none": 0, "full": 1, "chacha": 2, "chacha_rand03": 3}
 
 
 def embed_participate(

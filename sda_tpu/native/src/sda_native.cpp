@@ -4,9 +4,10 @@
 // threshold-secret-sharing Rust crate; sda-tpu binds libsodium directly via
 // ctypes and re-owns the field math here: an exact C++ oracle for the modular
 // matmul kernels (independent of numpy/XLA, used for bit-exactness audits)
-// plus a fast ChaCha20 mask PRG implementing CHACHA_PRG_V1
-// (sda_tpu/fields/chacha.py) for the recipient's seed re-expansion hot loop
-// (reference: client/src/receive.rs:102-118, masking/chacha.rs:57-77).
+// plus fast ChaCha20 mask PRGs implementing both CHACHA_PRG_V1 and the
+// rand-0.3-compatible CHACHA_PRG_RAND03 (sda_tpu/fields/chacha.py) for the
+// recipient's seed re-expansion hot loop (reference:
+// client/src/receive.rs:102-118, masking/chacha.rs:57-77).
 //
 // Build: g++ -O3 -shared -fPIC (see build.py). ABI: plain C, int64/uint32
 // buffers owned by the caller.
@@ -119,11 +120,46 @@ int sda_chacha_expand_mask(const uint32_t* seed, int64_t seed_words,
     return 0;
 }
 
+// The exact rand-0.3 ChaChaRng stream (CHACHA_PRG_RAND03) — what the
+// reference's masker actually draws (client/src/crypto/masking/
+// chacha.rs:37-41 via rand 0.3's chacha.rs + distributions/range.rs).
+// Same block function; u64 draws take the FIRST keystream word as the
+// HIGH half (rand 0.3's default next_u64) and the acceptance zone is
+// UINT64_MAX - UINT64_MAX % m, exclusive. Bit-identical to
+// sda_tpu.fields.chacha.expand_mask_rand03.
+int sda_chacha_expand_mask_r03(const uint32_t* seed, int64_t seed_words,
+                               int64_t dim, int64_t modulus, int64_t* out) {
+    if (modulus <= 0 || dim < 0 || seed_words < 0 || seed_words > 8) return 1;
+    uint32_t key[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    for (int64_t i = 0; i < seed_words; ++i) key[i] = seed[i];
+    const uint64_t m = (uint64_t)modulus;
+    const uint64_t zone_excl = UINT64_MAX - UINT64_MAX % m;  // accept v < zone
+    uint32_t counter = 0;
+    int64_t filled = 0;
+    uint32_t words[16];
+    while (filled < dim) {
+        chacha_block(key, counter++, words);
+        for (int lane = 0; lane < 8 && filled < dim; ++lane) {
+            uint64_t hi = words[2 * lane];
+            uint64_t lo = words[2 * lane + 1];
+            uint64_t v = (hi << 32) | lo;
+            if (v < zone_excl) out[filled++] = (int64_t)(v % m);
+        }
+    }
+    return 0;
+}
+
 // Sum of many expanded masks mod m — the recipient hot loop in one call:
 // seeds[n_seeds, seed_words] (as i64 per wire convention) -> out[dim].
-int sda_chacha_combine_masks(const int64_t* seeds, int64_t n_seeds,
-                             int64_t seed_words, int64_t dim, int64_t modulus,
-                             int64_t* scratch, int64_t* out) {
+// `expand` selects the PRG (shared body for the V1 and rand-0.3 entry
+// points below).
+typedef int (*sda_expand_fn)(const uint32_t*, int64_t, int64_t, int64_t,
+                             int64_t*);
+
+static int combine_masks_with(sda_expand_fn expand, const int64_t* seeds,
+                              int64_t n_seeds, int64_t seed_words,
+                              int64_t dim, int64_t modulus, int64_t* scratch,
+                              int64_t* out) {
     if (modulus <= 0) return 1;
     for (int64_t j = 0; j < dim; ++j) out[j] = 0;
     uint32_t seed32[8];
@@ -131,7 +167,7 @@ int sda_chacha_combine_masks(const int64_t* seeds, int64_t n_seeds,
         if (seed_words > 8) return 1;
         for (int64_t w = 0; w < seed_words; ++w)
             seed32[w] = (uint32_t)(uint64_t)seeds[s * seed_words + w];
-        int rc = sda_chacha_expand_mask(seed32, seed_words, dim, modulus, scratch);
+        int rc = expand(seed32, seed_words, dim, modulus, scratch);
         if (rc) return rc;
         for (int64_t j = 0; j < dim; ++j) {
             int64_t v = out[j] + scratch[j];
@@ -139,6 +175,21 @@ int sda_chacha_combine_masks(const int64_t* seeds, int64_t n_seeds,
         }
     }
     return 0;
+}
+
+int sda_chacha_combine_masks(const int64_t* seeds, int64_t n_seeds,
+                             int64_t seed_words, int64_t dim, int64_t modulus,
+                             int64_t* scratch, int64_t* out) {
+    return combine_masks_with(sda_chacha_expand_mask, seeds, n_seeds,
+                              seed_words, dim, modulus, scratch, out);
+}
+
+int sda_chacha_combine_masks_r03(const int64_t* seeds, int64_t n_seeds,
+                                 int64_t seed_words, int64_t dim,
+                                 int64_t modulus, int64_t* scratch,
+                                 int64_t* out) {
+    return combine_masks_with(sda_chacha_expand_mask_r03, seeds, n_seeds,
+                              seed_words, dim, modulus, scratch, out);
 }
 
 // ---------------------------------------------------------------------------
@@ -521,7 +572,12 @@ static int mask_phase(Sodium& s, const int64_t* secret, int64_t dim,
         uint32_t seed[8] = {0};
         s.randombytes(seed, (size_t)words * 4);
         std::vector<int64_t> mask((size_t)dim);
-        if (sda_chacha_expand_mask(seed, words, dim, modulus, mask.data()))
+        // kind 2 = CHACHA_PRG_V1, kind 3 = CHACHA_PRG_RAND03 (the stream a
+        // bare Rust-shaped scheme implies; rand-0.3 interop)
+        int rc_expand = masking_kind == 3
+            ? sda_chacha_expand_mask_r03(seed, words, dim, modulus, mask.data())
+            : sda_chacha_expand_mask(seed, words, dim, modulus, mask.data());
+        if (rc_expand)
             return 3;
         for (int64_t i = 0; i < dim; ++i) {
             uint64_t v = (uint64_t)masked[(size_t)i]
@@ -544,7 +600,8 @@ extern "C" {
 // Full participant compute for one aggregation input.
 //
 //   secret[dim]    any int64 values; canonicalized mod `modulus`
-//   masking_kind   0 = none, 1 = full, 2 = chacha (seed_bits in 32..256,
+//   masking_kind   0 = none, 1 = full, 2 = chacha CHACHA_PRG_V1,
+//                  3 = chacha CHACHA_PRG_RAND03 (seed_bits in 32..256,
 //                  multiple of 32)
 //   recipient_pk   32-byte Curve25519 pk (ignored for masking none)
 //   clerk_pks      share_count x 32 bytes, committee order
@@ -562,7 +619,7 @@ int sda_embed_participate(
     const uint8_t* recipient_pk, const uint8_t* clerk_pks,
     uint8_t* out, int64_t out_cap, int64_t* out_lens) {
     if (dim < 0 || modulus <= 0 || share_count < 1) return 3;
-    if (masking_kind < 0 || masking_kind > 2) return 3;
+    if (masking_kind < 0 || masking_kind > 3) return 3;
     Sodium& s = sodium();
     if (!s.ok) return 1;
     const uint64_t m = (uint64_t)modulus;
@@ -634,7 +691,7 @@ int sda_embed_participate_shamir(
     if (mask_modulus <= 0 || mask_modulus > share_modulus) return 3;
     if (k < 1 || m2 < k + 1) return 3;
     if (share_modulus >= (int64_t)1 << 62) return 3;  // u128 accum bound
-    if (masking_kind < 0 || masking_kind > 2) return 3;
+    if (masking_kind < 0 || masking_kind > 3) return 3;
     Sodium& s = sodium();
     if (!s.ok) return 1;
     const uint64_t m = (uint64_t)share_modulus;
@@ -680,6 +737,6 @@ int sda_embed_participate_shamir(
     return 0;
 }
 
-int sda_native_abi_version() { return 4; }
+int sda_native_abi_version() { return 5; }
 
 }  // extern "C"

@@ -19,6 +19,8 @@ from .helpers import (
     canonical_json,
 )
 from .crypto import (
+    CHACHA_PRG_RAND03,
+    CHACHA_PRG_V1,
     AdditiveEncryptionScheme,
     AdditiveSharing,
     BasicShamirSharing,

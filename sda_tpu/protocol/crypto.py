@@ -51,6 +51,22 @@ class VerificationKey(TaggedEnum):
 # ---------------------------------------------------------------------------
 # Masking schemes (crypto.rs:43-75)
 
+#: ChaCha mask-PRG identifiers. The bare Rust wire shape (no "prg" key)
+#: means the stream the reference actually draws — rand 0.3's ChaChaRng
+#: (crypto.rs:53 documents the scheme as `rand::chacha::ChaChaRng`) — so a
+#: scheme parsed from a Rust peer expands masks identically here and a
+#: mixed round reveals the CORRECT aggregate. The TPU-native CHACHA_PRG_V1
+#: spec is an explicit opt-in extension serialized as an extra "prg" key.
+#: Unknown tags are rejected at parse time: an unrecognized stream must
+#: fail loudly, never silently alias another one (that is the
+#: wrong-aggregate hazard the tag exists to prevent). Literals duplicated
+#: in fields.chacha (the spec home) to keep this wire layer import-light;
+#: tests pin the two sets equal.
+CHACHA_PRG_RAND03 = "rand-0.3/chacharng"
+CHACHA_PRG_V1 = "sda-tpu/chacha20-prg/v1"
+_CHACHA_PRGS = (CHACHA_PRG_RAND03, CHACHA_PRG_V1)
+
+
 class LinearMaskingScheme:
     """Masking between recipient and committee; subclasses are the variants."""
 
@@ -73,6 +89,7 @@ class LinearMaskingScheme:
                     modulus=p["modulus"],
                     dimension=p["dimension"],
                     seed_bitsize=p["seed_bitsize"],
+                    prg=p.get("prg", CHACHA_PRG_RAND03),
                 )
         raise ValueError(f"unknown masking scheme {obj!r}")
 
@@ -108,22 +125,32 @@ class ChaChaMasking(LinearMaskingScheme):
     """Seed-compressed masking: upload a <=256-bit seed, not an O(d) mask.
 
     Trades upload/download bandwidth for seed-expansion compute on both
-    participant and recipient sides (crypto.rs:53-62).
+    participant and recipient sides (crypto.rs:53-62). ``prg`` names the
+    expansion stream; the default (CHACHA_PRG_RAND03) serializes to the
+    exact Rust wire shape and draws the exact rand-0.3 ChaChaRng stream,
+    so rounds mixed with a Rust peer stay correct.
     """
 
-    def __init__(self, modulus: int, dimension: int, seed_bitsize: int):
+    def __init__(self, modulus: int, dimension: int, seed_bitsize: int,
+                 prg: str = CHACHA_PRG_RAND03):
         self.modulus = int(modulus)
         self.dimension = int(dimension)
         self.seed_bitsize = int(seed_bitsize)
+        if prg not in _CHACHA_PRGS:
+            raise ValueError(
+                f"unknown ChaCha PRG {prg!r}; known: {list(_CHACHA_PRGS)}"
+            )
+        self.prg = str(prg)
 
     def to_obj(self):
-        return {
-            "ChaCha": {
-                "modulus": self.modulus,
-                "dimension": self.dimension,
-                "seed_bitsize": self.seed_bitsize,
-            }
+        obj = {
+            "modulus": self.modulus,
+            "dimension": self.dimension,
+            "seed_bitsize": self.seed_bitsize,
         }
+        if self.prg != CHACHA_PRG_RAND03:
+            obj["prg"] = self.prg
+        return {"ChaCha": obj}
 
 
 # ---------------------------------------------------------------------------

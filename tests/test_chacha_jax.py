@@ -29,7 +29,7 @@ def test_block_words_match_host(seed, nblocks):
 @pytest.mark.parametrize("dimension", [1, 7, 8, 9, 100, 1000])
 def test_expand_mask_matches_host(modulus, dimension):
     seed = chacha.random_seed(128)
-    got = chacha_jax.expand_mask(seed, dimension, modulus)
+    got = chacha_jax.expand_mask(seed, dimension, modulus, prg=chacha.CHACHA_PRG_V1)
     exp = chacha.expand_mask(seed, dimension, modulus)
     np.testing.assert_array_equal(got, exp)
 
@@ -37,7 +37,7 @@ def test_expand_mask_matches_host(modulus, dimension):
 def test_combine_masks_matches_host_sum():
     modulus, dimension = 536870233, 257
     seeds = [chacha.random_seed(128) for _ in range(5)]
-    got = chacha_jax.combine_masks(seeds, dimension, modulus)
+    got = chacha_jax.combine_masks(seeds, dimension, modulus, prg=chacha.CHACHA_PRG_V1)
     exp = np.zeros(dimension, dtype=np.int64)
     for s in seeds:
         exp = (exp + chacha.expand_mask(s, dimension, modulus)) % modulus
@@ -50,7 +50,7 @@ def test_combine_masks_large_modulus_no_i64_overflow():
     modulus = (1 << 61) - 1  # 4+ masks of this size overflow a flat i64 sum
     dimension = 33
     seeds = [chacha.random_seed(128) for _ in range(9)]
-    got = chacha_jax.combine_masks(seeds, dimension, modulus)
+    got = chacha_jax.combine_masks(seeds, dimension, modulus, prg=chacha.CHACHA_PRG_V1)
     exp = np.zeros(dimension, dtype=object)
     for s in seeds:
         exp = (exp + chacha.expand_mask(s, dimension, modulus)) % modulus
@@ -59,7 +59,7 @@ def test_combine_masks_large_modulus_no_i64_overflow():
 
 def test_combine_masks_rejects_out_of_range_modulus():
     with pytest.raises(ValueError):
-        chacha_jax.combine_masks([[1]], 4, 1 << 62)
+        chacha_jax.combine_masks([[1]], 4, 1 << 62, prg=chacha.CHACHA_PRG_V1)
 
 
 def test_native_oracle_agreement():
@@ -71,7 +71,7 @@ def test_native_oracle_agreement():
     modulus, dimension = 433, 123
     seed = [7, 11, 13, 17]
     a = chacha.expand_mask(seed, dimension, modulus)
-    b = chacha_jax.expand_mask(seed, dimension, modulus)
-    c = native.chacha_expand_mask(seed, dimension, modulus)
+    b = chacha_jax.expand_mask(seed, dimension, modulus, prg=chacha.CHACHA_PRG_V1)
+    c = native.chacha_expand_mask(seed, dimension, modulus, prg=chacha.CHACHA_PRG_V1)
     np.testing.assert_array_equal(a, b)
     np.testing.assert_array_equal(a, c)

@@ -1,0 +1,290 @@
+"""rand-0.3 ChaChaRng wire interop (CHACHA_PRG_RAND03).
+
+The reference masks via rand 0.3's ``ChaChaRng::from_seed(&[u32])`` +
+``gen_range(0_i64, modulus)`` (client/src/crypto/masking/chacha.rs:24-77).
+Round-4's verdict flagged that sda-tpu's own CHACHA_PRG_V1 stream shared
+the Rust scheme's wire *shape* while drawing a different stream — a mixed
+Rust/sda-tpu round would silently reveal a wrong aggregate. These tests pin
+the fix:
+
+- a straight-line sequential transcription of the rand 0.3 algorithm
+  (``Rand03ChaChaRng`` below — the fixture oracle, deliberately a separate
+  code path from the vectorized implementations);
+- RFC 8439 A.1 keystream vectors as external ground truth for the shared
+  ChaCha20 block function (rand 0.3's 128-bit block counter coincides with
+  the RFC layout at zero nonce for < 2^32 blocks);
+- bit-identity of the numpy / native C++ / jax rand03 expansions against
+  the oracle, including rejection-heavy and power-of-two moduli;
+- the wire contract: a bare Rust-shaped scheme object means rand03, the V1
+  stream is an explicit tag, unknown tags fail loudly at parse time.
+
+No cargo exists in this image, so an executed-Rust capture is impossible;
+the oracle transcription (cited to the crate's files) is the strongest
+available fixture and is honestly labelled as such in the README.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sda_tpu.crypto import masking
+from sda_tpu.fields import chacha, chacha_jax
+from sda_tpu.protocol import (
+    CHACHA_PRG_RAND03,
+    CHACHA_PRG_V1,
+    ChaChaMasking,
+    LinearMaskingScheme,
+)
+from sda_tpu import native
+
+
+# ---------------------------------------------------------------------------
+# The fixture oracle: rand 0.3's ChaChaRng, transcribed line by line.
+
+_M32 = 0xFFFFFFFF
+
+
+class Rand03ChaChaRng:
+    """Sequential transcription of rand 0.3's ``ChaChaRng`` (rand-0.3
+    src/chacha.rs: ``init``/``update``/``next_u32``/``from_seed``), the
+    default ``Rng::next_u64`` (first draw = high half), and the i64
+    ``gen_range`` rejection sampler (src/distributions/range.rs:
+    ``zone = u64::MAX - u64::MAX % range``, accept ``v < zone``)."""
+
+    def __init__(self, seed_words):
+        # from_seed: init with zero key, then copy seed into state[4..12]
+        # (shorter seeds leave the remaining key words zero)
+        self.state = [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574] + [0] * 12
+        for i, w in enumerate(list(seed_words)[:8]):
+            self.state[4 + i] = int(w) & _M32
+        self.buffer = [0] * 16
+        self.index = 16  # STATE_WORDS: forces update() on the first draw
+
+    def _update(self):
+        x = list(self.state)
+
+        def qr(a, b, c, d):
+            x[a] = (x[a] + x[b]) & _M32
+            x[d] ^= x[a]
+            x[d] = ((x[d] << 16) | (x[d] >> 16)) & _M32
+            x[c] = (x[c] + x[d]) & _M32
+            x[b] ^= x[c]
+            x[b] = ((x[b] << 12) | (x[b] >> 20)) & _M32
+            x[a] = (x[a] + x[b]) & _M32
+            x[d] ^= x[a]
+            x[d] = ((x[d] << 8) | (x[d] >> 24)) & _M32
+            x[c] = (x[c] + x[d]) & _M32
+            x[b] ^= x[c]
+            x[b] = ((x[b] << 7) | (x[b] >> 25)) & _M32
+
+        for _ in range(10):  # CHACHA_ROUNDS / 2 double rounds
+            qr(0, 4, 8, 12)
+            qr(1, 5, 9, 13)
+            qr(2, 6, 10, 14)
+            qr(3, 7, 11, 15)
+            qr(0, 5, 10, 15)
+            qr(1, 6, 11, 12)
+            qr(2, 7, 8, 13)
+            qr(3, 4, 9, 14)
+        self.buffer = [(xi + si) & _M32 for xi, si in zip(x, self.state)]
+        self.index = 0
+        # 128-bit block counter across words 12..15 (chacha.rs update())
+        for w in range(12, 16):
+            self.state[w] = (self.state[w] + 1) & _M32
+            if self.state[w] != 0:
+                break
+
+    def next_u32(self) -> int:
+        if self.index == 16:
+            self._update()
+        v = self.buffer[self.index]
+        self.index += 1
+        return v
+
+    def next_u64(self) -> int:
+        # Rng::next_u64 default: ((next_u32 as u64) << 32) | next_u32
+        hi = self.next_u32()
+        lo = self.next_u32()
+        return (hi << 32) | lo
+
+    def gen_range_i64(self, low: int, high: int) -> int:
+        rng = high - low
+        umax = (1 << 64) - 1
+        zone = umax - umax % rng
+        while True:
+            v = self.next_u64()
+            if v < zone:
+                return low + v % rng
+
+    def expand(self, dimension: int, modulus: int) -> np.ndarray:
+        return np.array(
+            [self.gen_range_i64(0, modulus) for _ in range(dimension)],
+            dtype=np.int64,
+        )
+
+
+# ---------------------------------------------------------------------------
+# External ground truth for the shared block function.
+
+# RFC 8439 A.1 test vectors #1/#2: zero key, zero nonce, counters 0 and 1.
+# With a zero nonce the RFC state layout equals rand 0.3's 128-bit-counter
+# layout, so these pin the block function both PRGs share.
+_RFC8439_BLOCK0 = bytes.fromhex(
+    "76b8e0ada0f13d90405d6ae55386bd28"
+    "bdd219b8a08ded1aa836efcc8b770dc7"
+    "da41597c5157488d7724e03fb8d84a37"
+    "6a43b8f41518a11cc387b669b2ee6586"
+)
+_RFC8439_BLOCK1 = bytes.fromhex(
+    "9f07e7be5551387a98ba977c732d080d"
+    "cb0f29a048e3656912c6533e32ee7aed"
+    "29b721769ce64e43d57133b074d839d5"
+    "31ed1f28510afb45ace10a1f4b794d6f"
+)
+
+
+def test_block_function_matches_rfc8439():
+    words = chacha.chacha_block_words([], 0, 2)
+    assert words[0].astype("<u4").tobytes() == _RFC8439_BLOCK0
+    assert words[1].astype("<u4").tobytes() == _RFC8439_BLOCK1
+
+
+def test_oracle_buffer_matches_rfc8439():
+    """The oracle's own block output against the RFC — so a shared
+    transcription error between oracle and implementation cannot hide."""
+    rng = Rand03ChaChaRng([])
+    stream = bytes()
+    for _ in range(32):  # two blocks of u32 words, little-endian
+        stream += rng.next_u32().to_bytes(4, "little")
+    assert stream == _RFC8439_BLOCK0 + _RFC8439_BLOCK1
+
+
+# ---------------------------------------------------------------------------
+# Vectorized implementations == oracle.
+
+_CASES = [
+    # (seed, dimension, modulus)
+    ([1, 2, 3, 4], 100, 433),
+    ([0xDEADBEEF, 0x01234567, 0x89ABCDEF], 257, 536870233),
+    ([7], 1, 2),
+    ([0xFFFFFFFF] * 8, 65, 1024),  # power-of-two modulus: rand03 != V1 zone
+    ([5, 6, 7, 8], 200, (1 << 61) + 1),  # ~12.5% rejection per draw
+    ([9, 10, 11, 12, 13, 14, 15, 16], 1000, (1 << 62) - 57),
+]
+
+
+@pytest.mark.parametrize("seed,dim,modulus", _CASES)
+def test_numpy_rand03_matches_oracle(seed, dim, modulus):
+    got = chacha.expand_mask_rand03(seed, dim, modulus)
+    exp = Rand03ChaChaRng(seed).expand(dim, modulus)
+    np.testing.assert_array_equal(got, exp)
+
+
+@pytest.mark.parametrize("seed,dim,modulus", _CASES)
+def test_native_rand03_matches_oracle(seed, dim, modulus):
+    if not native.available():
+        pytest.skip("native library unavailable")
+    got = native.chacha_expand_mask(seed, dim, modulus, prg=CHACHA_PRG_RAND03)
+    exp = Rand03ChaChaRng(seed).expand(dim, modulus)
+    np.testing.assert_array_equal(got, exp)
+
+
+@pytest.mark.parametrize("seed,dim,modulus", _CASES)
+def test_jax_rand03_matches_oracle(seed, dim, modulus):
+    got = chacha_jax.expand_mask(seed, dim, modulus, prg=CHACHA_PRG_RAND03)
+    exp = Rand03ChaChaRng(seed).expand(dim, modulus)
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_combine_rand03_all_backends():
+    seeds = np.array(
+        [[1, 2, 3, 4], [5, 6, 7, 8], [0xFFFFFFFF, 0, 1, 2]], dtype=np.int64
+    )
+    dim, m = 150, 433
+    exp = np.zeros(dim, dtype=np.int64)
+    for s in seeds:
+        exp = (exp + Rand03ChaChaRng(s).expand(dim, m)) % m
+    np.testing.assert_array_equal(
+        chacha_jax.combine_masks(
+            [list(map(int, s)) for s in seeds], dim, m, prg=CHACHA_PRG_RAND03
+        ),
+        exp,
+    )
+    if native.available():
+        np.testing.assert_array_equal(
+            native.chacha_combine_masks(seeds, dim, m, prg=CHACHA_PRG_RAND03),
+            exp,
+        )
+
+
+def test_streams_actually_differ():
+    """Guard against the two tags silently aliasing one stream."""
+    seed, dim, m = [1, 2, 3, 4], 64, 433
+    v1 = chacha.expand_mask(seed, dim, m)
+    r03 = chacha.expand_mask_rand03(seed, dim, m)
+    assert not np.array_equal(v1, r03)
+
+
+# ---------------------------------------------------------------------------
+# Wire contract.
+
+def test_bare_rust_shape_means_rand03():
+    obj = {"ChaCha": {"modulus": 433, "dimension": 10, "seed_bitsize": 128}}
+    scheme = LinearMaskingScheme.from_obj(obj)
+    assert scheme.prg == CHACHA_PRG_RAND03
+    # and it serializes straight back to the byte-identical Rust shape
+    assert scheme.to_obj() == obj
+
+
+def test_v1_tag_roundtrips():
+    scheme = ChaChaMasking(433, 10, 128, prg=CHACHA_PRG_V1)
+    obj = scheme.to_obj()
+    assert obj["ChaCha"]["prg"] == CHACHA_PRG_V1
+    back = LinearMaskingScheme.from_obj(obj)
+    assert back == scheme and back.prg == CHACHA_PRG_V1
+
+
+def test_unknown_prg_fails_loudly_at_parse():
+    obj = {"ChaCha": {"modulus": 433, "dimension": 10, "seed_bitsize": 128,
+                      "prg": "rand-0.5/chacharng"}}
+    with pytest.raises(ValueError, match="unknown ChaCha PRG"):
+        LinearMaskingScheme.from_obj(obj)
+    with pytest.raises(ValueError, match="unknown ChaCha PRG"):
+        ChaChaMasking(433, 10, 128, prg="nonsense")
+
+
+def test_prg_constants_pinned_across_layers():
+    """The wire layer duplicates the literals to stay import-light; the
+    native loader keys its symbol map on them too. All three must agree."""
+    assert CHACHA_PRG_V1 == chacha.CHACHA_PRG_V1
+    assert CHACHA_PRG_RAND03 == chacha.CHACHA_PRG_RAND03
+    assert set(native._CHACHA_FNS) == {CHACHA_PRG_V1, CHACHA_PRG_RAND03}
+    assert set(chacha._EXPANDERS) == {CHACHA_PRG_V1, CHACHA_PRG_RAND03}
+
+
+@pytest.mark.parametrize("prg", [CHACHA_PRG_RAND03, CHACHA_PRG_V1])
+def test_masking_roundtrip_both_prgs(prg):
+    scheme = ChaChaMasking(433, 32, 128, prg=prg)
+    masker = masking.new_secret_masker(scheme)
+    combiner = masking.new_mask_combiner(scheme)
+    unmasker = masking.new_secret_unmasker(scheme)
+    s1 = np.arange(32, dtype=np.int64) % 433
+    s2 = (np.arange(32, dtype=np.int64) * 7 + 5) % 433
+    m1, x1 = masker.mask(s1)
+    m2, x2 = masker.mask(s2)
+    total = combiner.combine([m1, m2])
+    out = unmasker.unmask(total, (x1 + x2) % 433)
+    np.testing.assert_array_equal(out, (s1 + s2) % 433)
+
+
+def test_rand03_mask_then_oracle_combine():
+    """A participant masked by the dispatcher must be unmaskable by a PEER
+    whose combine is the oracle itself — i.e. a faithful Rust recipient
+    recovers the right aggregate from our participation."""
+    scheme = ChaChaMasking(433, 50, 128)  # default prg: rand03
+    masker = masking.new_secret_masker(scheme)
+    s = (np.arange(50, dtype=np.int64) * 3 + 1) % 433
+    seed, masked = masker.mask(s)
+    peer_mask = Rand03ChaChaRng([int(w) for w in seed]).expand(50, 433)
+    np.testing.assert_array_equal((masked - peer_mask) % 433, s)

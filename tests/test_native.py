@@ -50,7 +50,7 @@ def test_native_chacha_bit_exact_with_python_spec():
     seed = [0xDEADBEEF, 0x12345678, 0x9ABCDEF0, 0x0F0F0F0F]
     for dim, m in [(1, 433), (1000, 433), (257, 754974721), (64, 2)]:
         np.testing.assert_array_equal(
-            native.chacha_expand_mask(seed, dim, m),
+            native.chacha_expand_mask(seed, dim, m, prg=chacha.CHACHA_PRG_V1),
             chacha.expand_mask(seed, dim, m),
         )
 
@@ -62,7 +62,8 @@ def test_native_chacha_combine():
     for s in seeds:
         expect = (expect + chacha.expand_mask([int(w) for w in s], dim, m)) % m
     np.testing.assert_array_equal(
-        native.chacha_combine_masks(seeds, dim, m), expect
+        native.chacha_combine_masks(seeds, dim, m, prg=chacha.CHACHA_PRG_V1),
+        expect,
     )
 
 
