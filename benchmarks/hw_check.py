@@ -865,6 +865,28 @@ def watch(interval_s: float, probe_timeout_s: float, max_hours: float) -> int:
             results = _json_lines(bout)
             result = results[-1] if results else None
             record({"event": "bench", "rc": brc, "result": result})
+            if (brc == 0 and isinstance(result, dict)
+                    and result.get("platform") == "tpu"
+                    and not result.get("reused_capture")):
+                # save the in-window bench line so a later DRIVER bench.py
+                # run with the tunnel down re-emits this real-chip result
+                # with explicit provenance instead of the CPU floor
+                # (bench._fresh_tpu_capture; round-4 verdict #3). A
+                # reused_capture output must NOT be re-captured: that
+                # would reset the 48h age gate and launder the same stale
+                # measurement back to age 0 every window whose rungs fail.
+                cap_path = (os.environ.get("SDA_BENCH_CAPTURE_PATH")
+                            or os.path.join(here, "BENCH_TPU_CAPTURE.json"))
+                tmp = cap_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({
+                        "captured_at": datetime.datetime.now(
+                            datetime.timezone.utc
+                        ).isoformat(timespec="seconds"),
+                        "result": result,
+                    }, f, indent=1)
+                os.replace(tmp, cap_path)
+                record({"event": "bench_capture", "path": cap_path})
             # same window, no operator in the loop: grab the component
             # budget + MXU fold A/B while the chip still answers (forced
             # tpu — the stall culling handles a tunnel that died). One

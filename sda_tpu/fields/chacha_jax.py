@@ -75,8 +75,7 @@ def chacha_block_words(seed_words, counter0, *, nblocks: int):
 
 
 @functools.partial(jax.jit, static_argnames=("dimension", "modulus", "prg"))
-def _expand_no_reject(seed_words, *, dimension: int, modulus: int,
-                      prg: str = chacha.CHACHA_PRG_V1):
+def _expand_no_reject(seed_words, *, dimension: int, modulus: int, prg: str):
     """(mask [dimension] int64, any_rejected bool) — fast path.
 
     ``prg`` selects the stream: CHACHA_PRG_V1 (word[2i] = low half, zone
@@ -157,8 +156,7 @@ def _modsum_i64(x, modulus: int, axis: int = 0):
 
 
 @functools.partial(jax.jit, static_argnames=("dimension", "modulus", "prg"))
-def _combine_no_reject(seed_matrix, *, dimension: int, modulus: int,
-                       prg: str = chacha.CHACHA_PRG_V1):
+def _combine_no_reject(seed_matrix, *, dimension: int, modulus: int, prg: str):
     """[S, 8] seeds -> (sum of masks mod m [dimension] int64, [S] rejected)."""
     masks, rejected = jax.vmap(
         lambda sw: _expand_no_reject(
