@@ -7,7 +7,10 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 echo "== pytest (unit + integration + conformance, virtual 8-device mesh)"
-python -m pytest tests/ -q
+python -m pytest tests/ -q -m 'not chaos'
+
+echo "== chaos (fault injection under a fixed seed: failpoints, retry, lease/reissue)"
+env SDA_CHAOS_SEED=20260803 python -m pytest tests/ -q -m chaos
 
 echo "== CLI walkthrough (real sdad + sda over HTTP)"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu bash docs/walkthrough.sh | tail -1 | {

@@ -1,0 +1,242 @@
+"""Deterministic chaos layer: seedable failpoint injection.
+
+SDA's premise is surviving weak, sporadic devices (PAPER.md), so the
+failure modes themselves must be first-class and *reproducible*. This
+package is the injection side of that story: a process-global registry of
+named failpoints, each with a deterministic trigger schedule, hooked into
+the store backends (``store.*``), the HTTP dispatch (``http.server.*``)
+and the clerk loop (``clerk.*``). The recovery side lives in
+``http/client.py`` (retrying transport) and ``server/core.py`` +
+the store backends (clerking-job lease/reissue); ``docs/robustness.md``
+has the full catalog.
+
+Design follows the classic failpoint idiom (FreeBSD ``fail(9)``, Rust's
+``fail-rs``): production code calls ``chaos.fail("name")`` at a choke
+point; the call is a near-free no-op until a test or the ``sda-sim
+--chaos`` profile configures that name with an action:
+
+    chaos.configure("store.create_participation", error=True, times=2)
+    chaos.configure("http.server.request", error=True, rate=0.15, seed=7)
+    chaos.configure("http.server.request", delay=0.05, every=3)
+    chaos.configure("http.server.response", drop=True, times=1)
+
+Determinism: each failpoint owns a ``random.Random`` seeded from
+``(seed, name)`` and all trigger decisions are functions of the hit index
+only, taken under one lock — the same hit sequence always produces the
+same injection schedule, so a failing chaos run replays exactly.
+
+Every trigger is counted under ``chaos.<name>`` in ``utils/metrics.py``;
+``report()`` additionally returns per-point hit/trigger tallies.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+from ..protocol import ServerError
+from ..utils import metrics
+
+
+class InjectedFault(ServerError):
+    """The default injected error: an ``SdaError`` so the HTTP seam maps it
+    to a 500 (a transient server-side failure, exactly what the retrying
+    transport must absorb)."""
+
+
+class Action:
+    """What a triggered failpoint asks the call site to do.
+
+    ``kind`` is one of ``"error"`` (raise ``exc``), ``"delay"`` (sleep
+    ``delay_s`` then proceed), or ``"drop"`` (transport-level: abort the
+    connection / abandon the unit of work — only meaningful at call sites
+    that know how, e.g. the HTTP handler or the clerk loop).
+    """
+
+    __slots__ = ("kind", "exc", "delay_s")
+
+    def __init__(self, kind: str, exc: Optional[BaseException] = None,
+                 delay_s: float = 0.0):
+        self.kind = kind
+        self.exc = exc
+        self.delay_s = delay_s
+
+    def __repr__(self):
+        return f"Action({self.kind!r})"
+
+
+class _Failpoint:
+    def __init__(self, name: str, *, error=None, delay=None, drop=False,
+                 rate: float = 1.0, times: Optional[int] = None,
+                 every: Optional[int] = None, after: int = 0, seed: int = 0):
+        if sum(x is not None and x is not False for x in (error, delay)) + bool(drop) != 1:
+            raise ValueError(f"failpoint {name!r}: exactly one of "
+                             "error/delay/drop must be set")
+        if every is not None and every < 1:
+            raise ValueError(f"failpoint {name!r}: every must be >= 1")
+        self.name = name
+        if drop:
+            self.kind = "drop"
+        elif delay is not None:
+            self.kind = "delay"
+        else:
+            self.kind = "error"
+        # error=True means "use the default injected fault"
+        self.exc_factory = (
+            (lambda: InjectedFault(f"chaos: injected failure at {name}"))
+            if error is True or error is None
+            else (error if callable(error) else (lambda: error))
+        )
+        self.delay_s = float(delay or 0.0)
+        self.rate = float(rate)
+        self.times = times
+        self.every = every
+        self.after = int(after)
+        # per-point RNG keyed on (seed, name): schedules are independent
+        # across failpoints and reproducible for a given hit order
+        self.rng = random.Random(f"{seed}:{name}")
+        self.hits = 0
+        self.triggers = 0
+
+    def should_trigger(self) -> bool:
+        """Decide for the current hit; caller holds the registry lock."""
+        hit = self.hits
+        self.hits += 1
+        if hit < self.after:
+            return False
+        if self.times is not None and self.triggers >= self.times:
+            return False
+        if self.every is not None and (hit - self.after) % self.every != 0:
+            return False
+        if self.rate < 1.0 and self.rng.random() >= self.rate:
+            return False
+        self.triggers += 1
+        return True
+
+    def action(self) -> Action:
+        if self.kind == "error":
+            return Action("error", exc=self.exc_factory())
+        if self.kind == "delay":
+            return Action("delay", delay_s=self.delay_s)
+        return Action("drop")
+
+
+class FailpointRegistry:
+    """Thread-safe named-failpoint store. One process-global instance
+    (module-level ``configure``/``fail``/... below) serves both sides of
+    an in-process round; tests may build private registries."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._points: Dict[str, _Failpoint] = {}
+
+    def configure(self, name: str, **kwargs) -> None:
+        """(Re)arm a failpoint; see module docstring for the knobs."""
+        point = _Failpoint(name, **kwargs)
+        with self._lock:
+            self._points[name] = point
+
+    def clear(self, name: Optional[str] = None) -> None:
+        """Disarm one failpoint, or all of them."""
+        with self._lock:
+            if name is None:
+                self._points.clear()
+            else:
+                self._points.pop(name, None)
+
+    def active(self) -> bool:
+        return bool(self._points)
+
+    def evaluate(self, name: str, kinds=None) -> Optional[Action]:
+        """Return the action if ``name`` is armed and triggers this hit,
+        else None. Counts ``chaos.<name>`` on trigger. The un-armed path
+        is one dict lookup — cheap enough for hot paths.
+
+        ``kinds`` restricts the action kinds the call site can express
+        (e.g. the clerk loop only understands ``drop``); an armed
+        failpoint of another kind is ignored WITHOUT consuming a hit or
+        trigger, so the schedule and counters never claim an injection
+        that could not happen."""
+        point = self._points.get(name)
+        if point is None:
+            return None
+        if kinds is not None and point.kind not in kinds:
+            return None
+        with self._lock:
+            # re-check: a concurrent clear() may have raced the lookup
+            if self._points.get(name) is not point or not point.should_trigger():
+                return None
+            action = point.action()
+        metrics.count(f"chaos.{name}")
+        return action
+
+    def fail(self, name: str) -> Optional[Action]:
+        """The standard injection hook: raise on ``error``, sleep on
+        ``delay``. ``drop`` is transport-level and inexpressible here, so
+        a drop-armed point is ignored unconsumed (use ``evaluate`` with
+        ``kinds`` at call sites that can drop)."""
+        action = self.evaluate(name, kinds=("error", "delay"))
+        if action is None:
+            return None
+        if action.kind == "error":
+            raise action.exc
+        time.sleep(action.delay_s)
+        return action
+
+    def report(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                name: {"hits": p.hits, "triggers": p.triggers}
+                for name, p in sorted(self._points.items())
+            }
+
+
+#: The process-global registry every built-in hook consults.
+registry = FailpointRegistry()
+
+configure = registry.configure
+clear = registry.clear
+evaluate = registry.evaluate
+fail = registry.fail
+report = registry.report
+
+
+def reset() -> None:
+    """Disarm everything — test-teardown hygiene."""
+    registry.clear()
+
+
+def configure_from_spec(spec: str, seed: int = 0) -> None:
+    """Arm failpoints from a compact string (CLI / env friendly):
+
+        "http.server.request=error,rate=0.15;clerk.abandon_job=drop,times=1"
+
+    Each ``;``-separated entry is ``name=kind[,key=value...]`` with kind in
+    error|delay:SECONDS|drop and keys rate/times/every/after.
+    """
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, rest = entry.partition("=")
+        if not rest:
+            raise ValueError(f"chaos spec entry {entry!r}: expected name=kind[,...]")
+        parts = rest.split(",")
+        kind = parts[0].strip()
+        kwargs: dict = {"seed": seed}
+        if kind == "error":
+            kwargs["error"] = True
+        elif kind == "drop":
+            kwargs["drop"] = True
+        elif kind.startswith("delay:"):
+            kwargs["delay"] = float(kind.split(":", 1)[1])
+        else:
+            raise ValueError(f"chaos spec entry {entry!r}: unknown kind {kind!r}")
+        for part in parts[1:]:
+            key, _, value = part.strip().partition("=")
+            if key not in ("rate", "times", "every", "after"):
+                raise ValueError(f"chaos spec entry {entry!r}: unknown key {key!r}")
+            kwargs[key] = float(value) if key == "rate" else int(value)
+        configure(name.strip(), **kwargs)

@@ -1,0 +1,221 @@
+"""The chaos drill: one full federated round over real HTTP under fault
+injection — the executable proof behind ``sda-sim --chaos``.
+
+Everything hostile is injected deterministically through the failpoint
+registry (``sda_tpu.chaos``):
+
+- the HTTP dispatch 500s a seeded fraction of all requests
+  (``http.server.request``);
+- one response is dropped AFTER the server processed it
+  (``http.server.response``) — the lost-ack case create-once retries must
+  absorb;
+- the store rejects the first participation create
+  (``store.create_participation``);
+- one clerk dies right after pulling its job (``clerk.abandon_job``);
+  job leasing (``SdaServer.clerking_lease_seconds``) reissues the
+  abandoned job to the clerk's next live poll.
+
+The round must still reveal the bit-exact sum; the returned report carries
+every ``chaos.*`` / ``http.retry.*`` / ``server.job.*`` counter so the
+injection schedule is auditable.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from .. import chaos
+from ..utils import metrics
+
+
+def run_chaos_drill(
+    participants: int = 6,
+    dim: int = 4,
+    *,
+    rate: float = 0.15,
+    seed: int = 0,
+    lease_seconds: float = 0.75,
+    timeout_s: float = 60.0,
+    store: str = "memory",
+    store_path=None,
+    extra_spec: str = None,
+) -> dict:
+    """Run one full aggregation round over HTTP under injected faults.
+
+    Returns the report dict (``exact``, ``injected_ratio``, counters...).
+    Requires libsodium (real sealed-box crypto, as in production rounds).
+    """
+    import numpy as np
+
+    from ..client import SdaClient
+    from ..crypto import MemoryKeystore, sodium
+    from ..http import SdaHttpClient, SdaHttpServer
+    from ..protocol import (
+        Aggregation,
+        AggregationId,
+        FullMasking,
+        PackedShamirSharing,
+        SodiumEncryption,
+    )
+    from ..server import new_jsonfs_server, new_memory_server, new_sqlite_server
+
+    if not sodium.available():
+        raise RuntimeError("the chaos drill needs libsodium (real crypto round)")
+
+    # the golden 8-clerk packed-Shamir committee (tests/test_fault_tolerance):
+    # threshold 7 of 8, so the abandoned job is LIVENESS-critical only via
+    # reissue when every other result is present
+    scheme = PackedShamirSharing(
+        secret_count=3, share_count=8, privacy_threshold=4,
+        prime_modulus=433, omega_secrets=354, omega_shares=150,
+    )
+
+    metrics.reset_counters()
+    chaos.reset()
+
+    if store == "memory":
+        service_impl = new_memory_server()
+    elif store == "sqlite":
+        service_impl = new_sqlite_server(store_path or ":memory:")
+    elif store == "jsonfs":
+        if store_path is None:
+            raise ValueError("store='jsonfs' needs store_path")
+        service_impl = new_jsonfs_server(store_path)
+    else:
+        raise ValueError(f"unknown store {store!r}")
+    service_impl.server.clerking_lease_seconds = lease_seconds
+
+    http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
+    http_server.start_background()
+    try:
+        def new_client():
+            keystore = MemoryKeystore()
+            proxy = SdaHttpClient(
+                http_server.address,
+                token="chaos-drill-token",
+                # fast, deterministic-budget retries: the drill injects a
+                # bounded failure schedule, so a handful of quick attempts
+                # always clears it
+                max_retries=8, backoff_base=0.01, backoff_cap=0.1,
+            )
+            agent = SdaClient.new_agent(keystore)
+            return SdaClient(agent, keystore, proxy)
+
+        # -- clean setup (no injection yet: the drill targets the round) --
+        recipient = new_client()
+        recipient.upload_agent()
+        recipient_key = recipient.new_encryption_key()
+        recipient.upload_encryption_key(recipient_key)
+
+        # the recipient owns a key too, so it is a committee candidate —
+        # track every key-holding client by id and let the election decide
+        candidates = {recipient.agent.id: recipient}
+        for _ in range(scheme.share_count):
+            clerk = new_client()
+            clerk.upload_agent()
+            clerk.upload_encryption_key(clerk.new_encryption_key())
+            candidates[clerk.agent.id] = clerk
+
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="chaos-drill",
+            vector_dimension=dim,
+            modulus=scheme.prime_modulus,
+            recipient=recipient.agent.id,
+            recipient_key=recipient_key,
+            masking_scheme=FullMasking(scheme.prime_modulus),
+            committee_sharing_scheme=scheme,
+            recipient_encryption_scheme=SodiumEncryption(),
+            committee_encryption_scheme=SodiumEncryption(),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+        committee = recipient.service.get_committee(recipient.agent, agg.id)
+        clerks: List[SdaClient] = [
+            candidates[cid] for cid, _ in committee.clerks_and_keys
+        ]
+
+        # -- arm the failpoints, then run the whole round under fire ------
+        chaos.configure("http.server.request", error=True, rate=rate, seed=seed)
+        chaos.configure("http.server.response", drop=True, times=1, seed=seed)
+        chaos.configure("store.create_participation", error=True, times=1,
+                        seed=seed)
+        chaos.configure("clerk.abandon_job", drop=True, times=1, seed=seed)
+        if extra_spec:
+            chaos.configure_from_spec(extra_spec, seed=seed)
+
+        rng = np.random.default_rng(seed)
+        inputs = rng.integers(0, scheme.prime_modulus,
+                              size=(participants, dim), dtype=np.int64)
+        for row in inputs:
+            participant = new_client()
+            participant.upload_agent()
+            participant.participate([int(x) for x in row], agg.id)
+        recipient.end_aggregation(agg.id)  # snapshot + job fan-out
+
+        # clerks keep polling until EVERY job has a result — waiting for
+        # the full committee (not just reconstruction_threshold) is what
+        # forces the abandoned job through the lease-expiry reissue path
+        deadline = time.monotonic() + timeout_s
+        ready = False
+        while time.monotonic() < deadline:
+            for clerk in clerks:
+                clerk.run_chores(-1)
+            status = recipient.service.get_aggregation_status(
+                recipient.agent, agg.id
+            )
+            if (
+                status is not None
+                and status.snapshots
+                and status.snapshots[0].number_of_clerking_results
+                >= scheme.share_count
+            ):
+                ready = True
+                break
+            time.sleep(min(0.1, lease_seconds / 4))
+
+        exact = False
+        if ready:
+            output = recipient.reveal_aggregation(agg.id)
+            expected = inputs.sum(axis=0) % scheme.prime_modulus
+            exact = bool((output.positive().values == expected).all())
+    finally:
+        # snapshot the schedule, then disarm BEFORE shutdown so teardown
+        # requests aren't chaos'd
+        failpoint_report = chaos.report()
+        chaos.reset()
+        http_server.shutdown()
+
+    counters = metrics.counter_report()
+    injected = sum(v for k, v in counters.items() if k.startswith("chaos."))
+    # request-level failure accounting: dispatch 500s and store faults are
+    # already inside http.request (they produce a counted 500 reply);
+    # dropped responses bail out before the counter, so add them back
+    failed_requests = sum(
+        v for k, v in counters.items()
+        if k.startswith(("chaos.http.server.", "chaos.store."))
+    )
+    dropped = counters.get("chaos.http.server.response", 0)
+    requests_total = counters.get("http.request", 0) + dropped
+    report = {
+        "mode": f"chaos drill over HTTP ({store} store)",
+        "participants": participants,
+        "dim": dim,
+        "clerks": scheme.share_count,
+        "rate": rate,
+        "seed": seed,
+        "lease_seconds": lease_seconds,
+        "ready": ready,
+        "exact": exact,
+        "injected_faults": injected,
+        "failed_requests": failed_requests,
+        "injected_ratio": round(failed_requests / max(1, requests_total), 4),
+        "failpoints": failpoint_report or None,
+        "counters": {
+            k: v for k, v in counters.items()
+            if k.startswith(("chaos.", "http.retry.", "http.status.",
+                             "server.job.", "server.snapshot."))
+        },
+    }
+    return report

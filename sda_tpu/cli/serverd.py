@@ -23,6 +23,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--premix-paillier", action="store_true",
                         help="homomorphically combine clerk columns at "
                              "snapshot time for PackedPaillier aggregations")
+    parser.add_argument("--job-lease", type=float, metavar="SECONDS",
+                        default=None,
+                        help="lease polled clerking jobs for SECONDS: held "
+                             "jobs are invisible to the clerk's other "
+                             "workers and reissued after expiry (default: "
+                             "reference visible-poll semantics)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     sub = parser.add_subparsers(dest="command", required=True)
     httpd = sub.add_parser("httpd")
@@ -54,6 +60,8 @@ def main(argv=None) -> int:
 
     if args.premix_paillier:
         service.server.premix_paillier = True
+    if args.job_lease is not None:
+        service.server.clerking_lease_seconds = args.job_lease
 
     server = SdaHttpServer(service, bind=args.bind)
     print(f"sdad listening on {server.address}", flush=True)

@@ -46,6 +46,23 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--pallas", action="store_true",
                         help="fused Pallas local step (packed-Shamir x "
                              "Solinas x none/full masking; TPU)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="robustness profile: run a full federated "
+                             "round over real HTTP with deterministic "
+                             "fault injection (500s, dropped responses, "
+                             "store faults, one abandoned clerking job) "
+                             "and print the chaos/retry counter report")
+    parser.add_argument("--chaos-rate", type=float, default=0.15,
+                        help="fraction of HTTP requests to fail (--chaos)")
+    parser.add_argument("--chaos-seed", type=int, default=0,
+                        help="failpoint schedule seed (--chaos)")
+    parser.add_argument("--chaos-store", choices=["memory", "sqlite", "jsonfs"],
+                        default="memory",
+                        help="server store backend for --chaos")
+    parser.add_argument("--chaos-spec", type=str, default=None,
+                        help="extra failpoints, e.g. "
+                             "'store.poll_clerking_job=error,times=2' "
+                             "(see sda_tpu.chaos.configure_from_spec)")
     parser.add_argument("--drop-clerks", type=str, metavar="I,J,...",
                         default=None,
                         help="simulate losing these clerk indices: the "
@@ -131,6 +148,40 @@ def _run_multihost(args, argv=None) -> int:
     return rc
 
 
+def _run_chaos(args) -> int:
+    """--chaos: the robustness drill — a full federated round over real
+    HTTP under deterministic fault injection (sda_tpu/chaos/drill.py),
+    reported as the usual one JSON line. No mesh/JAX involved: this
+    profile exercises the transport/store/clerk seams, not the kernels."""
+    import tempfile
+
+    from ..chaos.drill import run_chaos_drill
+    from ..crypto import sodium
+
+    if not sodium.available():
+        print("error: --chaos needs libsodium (real-crypto federated round)",
+              file=sys.stderr)
+        return 1
+    # keep the drill small: real sealed-box crypto per participant over
+    # HTTP — robustness coverage, not throughput
+    participants = min(args.participants, 12)
+    dim = min(args.dim, 64)
+    if (participants, dim) != (args.participants, args.dim):
+        print(f"note: --chaos drills robustness, not scale; clamping to "
+              f"--participants {participants} --dim {dim}", file=sys.stderr)
+    with tempfile.TemporaryDirectory() as tmp:
+        report = run_chaos_drill(
+            participants, dim,
+            rate=args.chaos_rate,
+            seed=args.chaos_seed,
+            store=args.chaos_store,
+            store_path=None if args.chaos_store == "memory" else f"{tmp}/store",
+            extra_spec=args.chaos_spec,
+        )
+    print(json.dumps(report))
+    return 0 if report["exact"] else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from ..utils import (
@@ -142,6 +193,9 @@ def main(argv=None) -> int:
     )
 
     configure_logging(args.verbose)
+
+    if args.chaos:
+        return _run_chaos(args)
 
     import os
 

@@ -224,6 +224,13 @@ class SdaClient:
         job = self.service.get_clerking_job(self.agent, self.agent.id)
         if job is None:
             return False
+        # failpoint: the clerk dies AFTER pulling work — the job is pulled
+        # (and, with leasing, invisible to its siblings) but no result ever
+        # lands; lease expiry is what brings it back
+        from .. import chaos
+
+        if chaos.evaluate("clerk.abandon_job", kinds=("drop",)) is not None:
+            return False
         result = self.process_clerking_job(job)
         self.service.create_clerking_result(self.agent, result)
         return True

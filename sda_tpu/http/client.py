@@ -10,12 +10,18 @@ maps to ``None``; a bare 404 is a routing error (client.rs:65-72).
 
 from __future__ import annotations
 
+import logging
+import os as _os
+import random as _random
+import re as _re
+import time as _time
 import secrets as _secrets
 import threading as _threading
 from typing import List, Optional
 
 import requests
 
+from ..utils import metrics
 from ..protocol import (
     Agent,
     AgentId,
@@ -39,6 +45,43 @@ from ..protocol import (
 
 TOKEN_ALIAS = "auth-token"
 
+log = logging.getLogger(__name__)
+
+#: Statuses treated as transient server trouble — worth retrying.
+RETRYABLE_STATUSES = frozenset({500, 502, 503, 504})
+
+#: Every mutating route this client issues. All are POSTs whose server-side
+#: handlers are create-once / idempotent upserts keyed by a client-minted id
+#: (participations dedupe by participation id, results by (snapshot, job),
+#: snapshots by snapshot id with deterministic job ids, everything else is a
+#: plain upsert), so a retried POST after a lost response cannot duplicate a
+#: side effect. ``_post`` asserts membership: adding a non-idempotent route
+#: without reclassifying it here must fail loudly, not silently retry.
+_IDEMPOTENT_POST_ROUTES = tuple(
+    _re.compile(p)
+    for p in (
+        r"/v1/agents/me",
+        r"/v1/agents/me/profile",
+        r"/v1/agents/me/keys",
+        r"/v1/aggregations",
+        r"/v1/aggregations/implied/committee",
+        r"/v1/aggregations/implied/snapshot",
+        r"/v1/aggregations/participations",
+        r"/v1/aggregations/implied/jobs/[0-9a-fA-F-]{36}/result",
+    )
+)
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = _os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("ignoring unparseable %s=%r", name, raw)
+        return default
+
 
 def _load_or_mint_token(store, agent_id: AgentId) -> str:
     """Persisted per-identity token, minted on first use (tokenstore.rs:8-23)."""
@@ -60,10 +103,48 @@ class SdaHttpClient(SdaService):
     thread gets its own session; the token cache is lock-guarded.
     """
 
-    def __init__(self, base_url: str, store=None, token: Optional[str] = None):
+    def __init__(
+        self,
+        base_url: str,
+        store=None,
+        token: Optional[str] = None,
+        *,
+        timeout: Optional[float] = None,
+        max_retries: Optional[int] = None,
+        backoff_base: Optional[float] = None,
+        backoff_cap: Optional[float] = None,
+        deadline: Optional[float] = None,
+    ):
         self.base_url = base_url.rstrip("/")
         self.store = store
         self._fixed_token = token
+        #: per-request socket timeout; constructor beats SDA_HTTP_TIMEOUT
+        #: beats the historical 60 s default
+        self.timeout = (
+            timeout if timeout is not None else _env_float("SDA_HTTP_TIMEOUT", 60.0)
+        )
+        #: transient failures absorbed per operation before giving up
+        self.max_retries = int(
+            max_retries if max_retries is not None
+            else _env_float("SDA_HTTP_RETRIES", 4)
+        )
+        # exponential backoff with full jitter: sleep in
+        # [0, min(cap, base * 2^attempt)] — decorrelates retry storms from
+        # many sporadic clients hitting one recovering server
+        self.backoff_base = (
+            backoff_base if backoff_base is not None
+            else _env_float("SDA_HTTP_BACKOFF", 0.1)
+        )
+        self.backoff_cap = backoff_cap if backoff_cap is not None else 5.0
+        #: per-operation wall-clock budget across all attempts (sleeps
+        #: included); None derives it from timeout and retry count
+        self.deadline = (
+            deadline if deadline is not None
+            else _env_float(
+                "SDA_HTTP_DEADLINE",
+                (self.timeout + self.backoff_cap) * (self.max_retries + 1),
+            )
+        )
         self._tokens = {}  # per-caller cache; one proxy can serve many agents
         self._tokens_lock = _threading.Lock()
         self._local = _threading.local()
@@ -119,24 +200,76 @@ class SdaHttpClient(SdaService):
             raise InvalidRequest(body)
         raise ServerError(f"HTTP {response.status_code}: {body}")
 
+    def _request(self, method: str, path: str, *, params=None, json=None, auth=None):
+        """One logical operation: exponential-backoff retries around the
+        raw HTTP exchange, bounded by ``max_retries`` AND the
+        per-operation ``deadline``. Connection errors, timeouts, and
+        5xx responses are transient; everything else returns immediately
+        for ``_check`` to interpret."""
+        url = self.base_url + path
+        give_up_at = _time.monotonic() + self.deadline
+        attempt = 0
+        while True:
+            cause, error = None, None
+            # the deadline is a wall-clock budget: each attempt's socket
+            # timeout is clamped to what remains (floored so the first
+            # attempt always gets a chance even under a tiny deadline)
+            remaining = give_up_at - _time.monotonic()
+            try:
+                response = self.session.request(
+                    method, url, params=params, json=json, auth=auth,
+                    timeout=min(self.timeout, max(0.05, remaining)),
+                )
+            except requests.Timeout as e:
+                cause, error = "timeout", e
+            except requests.ConnectionError as e:
+                cause, error = "connection", e
+            else:
+                if response.status_code in RETRYABLE_STATUSES:
+                    cause = "status_5xx"
+                else:
+                    if attempt:
+                        metrics.count("http.retry.recovered")
+                    return response
+            attempt += 1
+            if attempt > self.max_retries or _time.monotonic() >= give_up_at:
+                metrics.count("http.retry.exhausted")
+                if error is not None:
+                    raise error
+                return response  # terminal 5xx: let _check raise ServerError
+            metrics.count("http.retry.attempt")
+            metrics.count(f"http.retry.{cause}")
+            sleep = _random.uniform(
+                0.0, min(self.backoff_cap, self.backoff_base * (2 ** (attempt - 1)))
+            )
+            sleep = min(sleep, max(0.0, give_up_at - _time.monotonic()))
+            log.debug(
+                "%s %s transient failure (%s), retry %d/%d in %.3fs",
+                method, path, cause, attempt, self.max_retries, sleep,
+            )
+            if sleep:
+                _time.sleep(sleep)
+
     def _get(self, caller: Agent, path: str, params=None):
         return self._check(
-            self.session.get(
-                self.base_url + path, params=params, auth=self._auth(caller), timeout=60
-            )
+            self._request("GET", path, params=params, auth=self._auth(caller))
         )
 
     def _post(self, caller: Agent, path: str, obj) -> None:
-        self._check(
-            self.session.post(
-                self.base_url + path, json=obj, auth=self._auth(caller), timeout=60
+        # POSTs are only retry-safe because every mutating route is a
+        # create-once/idempotent upsert server-side — enforce the claim
+        # (explicit raise, not `assert`: must survive python -O)
+        if not any(r.fullmatch(path) for r in _IDEMPOTENT_POST_ROUTES):
+            raise AssertionError(
+                f"POST {path} is not classified retry-safe; add it to "
+                "_IDEMPOTENT_POST_ROUTES only if its handler is idempotent"
             )
+        self._check(
+            self._request("POST", path, json=obj, auth=self._auth(caller))
         )
 
     def _delete(self, caller: Agent, path: str) -> None:
-        self._check(
-            self.session.delete(self.base_url + path, auth=self._auth(caller), timeout=60)
-        )
+        self._check(self._request("DELETE", path, auth=self._auth(caller)))
 
     @staticmethod
     def _option(response, codec):
@@ -144,7 +277,7 @@ class SdaHttpClient(SdaService):
 
     # -- service implementation --------------------------------------------
     def ping(self) -> Pong:
-        response = self.session.get(self.base_url + "/v1/ping", timeout=60)
+        response = self._request("GET", "/v1/ping")
         self._check(response)
         return Pong.from_obj(response.json())
 

@@ -66,6 +66,7 @@ from ..protocol import (
 )
 from ..server import SdaServerService, auth_token
 from ..utils import metrics
+from .. import chaos
 
 log = logging.getLogger(__name__)
 
@@ -105,6 +106,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _json_body(self):
         length = int(self.headers.get("Content-Length", 0))
         raw = self.rfile.read(length) if length else b""
+        self._body_consumed = True
         if not raw:
             return None
         try:
@@ -114,6 +116,35 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, status: int, obj=None, resource_not_found=False):
         body = b"" if obj is None else json.dumps(obj).encode("utf-8")
+        # failpoint: the service call already happened — dropping HERE
+        # simulates a lost response (side effect durable, client in the
+        # dark), the exact hazard create-once retry semantics must absorb;
+        # delay stalls the ack instead
+        action = chaos.evaluate("http.server.response", kinds=("drop", "delay"))
+        if action is not None:
+            if action.kind == "drop":
+                log.info("%s %s -> chaos-dropped response", self.command, self.path)
+                self.close_connection = True
+                return
+            time.sleep(action.delay_s)
+        # replying before the handler consumed the request body (auth
+        # failures, injected 500s, malformed-route errors on POSTs) would
+        # leave the body bytes in the keep-alive stream, where they get
+        # parsed as the next request line — drain them first, but bounded:
+        # a client that advertised a body and never sends it must not pin
+        # this thread, so a stalled drain forfeits the connection instead
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length and not self._body_consumed:
+            self._body_consumed = True
+            try:
+                previous = self.connection.gettimeout()
+                self.connection.settimeout(5.0)
+                try:
+                    self.rfile.read(length)
+                finally:
+                    self.connection.settimeout(previous)
+            except OSError:  # includes socket.timeout: framing is lost
+                self.close_connection = True
         # per-request status line + counters (reference: the rouille wrapper
         # logs method/path/status per request, server-http/src/lib.rs:105-122).
         # Counted BEFORE the body write: once a client has the response, the
@@ -144,17 +175,31 @@ class _Handler(BaseHTTPRequestHandler):
 
     _t0 = 0.0
     _counted = False
+    _body_consumed = False
 
     # -- dispatch ----------------------------------------------------------
     def _route(self, method: str):
         self._t0 = time.perf_counter()
         self._counted = False  # per-request (connections are reused)
+        self._body_consumed = False
         url = urlparse(self.path)
         path = url.path.rstrip("/")
         query = parse_qs(url.query)
 
         def m(pattern):
             return re.fullmatch(pattern, path)
+
+        # failpoint: transient transport trouble BEFORE any service work —
+        # injected 500s, response delays, or hard connection drops
+        action = chaos.evaluate("http.server.request")
+        if action is not None:
+            if action.kind == "error":
+                return self._reply(500, {"error": str(action.exc)})
+            if action.kind == "drop":
+                log.info("%s %s -> chaos-dropped connection", self.command, self.path)
+                self.close_connection = True
+                return
+            time.sleep(action.delay_s)  # "delay": proceed after the stall
 
         try:
             if method == "GET" and path == "/v1/ping":
@@ -346,4 +391,13 @@ class SdaHttpServer:
         self.httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # a wedged handler (stuck client socket, runaway store op)
+                # survives shutdown(); don't hang the caller forever, but
+                # don't hide the leak either
+                log.warning(
+                    "HTTP server thread did not stop within 5s; "
+                    "leaking daemon thread %s", self._thread.name,
+                )
+                metrics.count("http.shutdown.leaked")
         self.httpd.server_close()

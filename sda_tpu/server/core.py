@@ -11,6 +11,7 @@ framework's checkpoint/resume story (SURVEY.md §5.4).
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional
 
 from ..protocol import (
@@ -68,6 +69,16 @@ class SdaServer:
         #: snapshot time when the committee scheme is PackedPaillier
         #: (snapshot.py premixing) — clerk downloads shrink ~N x
         self.premix_paillier = False
+        #: opt-in (like premixing): when set, a polled clerking job is
+        #: LEASED for this many seconds — invisible to the clerk's other
+        #: workers while held, reissued to the next live poller once the
+        #: lease expires without a result. None keeps the reference's
+        #: visible-poll semantics (the job is returned on every poll).
+        self.clerking_lease_seconds: Optional[float] = None
+        # serializes the snapshot pipeline: a timed-out client retrying a
+        # slow snapshot POST must queue behind the original, not race its
+        # freeze/enqueue (snapshot.py relies on this for first-write-wins)
+        self._snapshot_lock = threading.Lock()
 
     # -- health ------------------------------------------------------------
     def ping(self) -> Pong:
@@ -156,12 +167,21 @@ class SdaServer:
         )
 
     def create_snapshot(self, snapshot: Snapshot) -> None:
-        snapshot_mod.snapshot(self, snapshot)
-        metrics.count("server.snapshot.created")
+        if snapshot_mod.snapshot(self, snapshot):
+            metrics.count("server.snapshot.created")
 
     # -- clerking ----------------------------------------------------------
     def poll_clerking_job(self, clerk: AgentId) -> Optional[ClerkingJob]:
-        job = self.clerking_job_store.poll_clerking_job(clerk)
+        if self.clerking_lease_seconds is not None:
+            leased = self.clerking_job_store.lease_clerking_job(
+                clerk, self.clerking_lease_seconds
+            )
+            job = None
+            if leased is not None:
+                job, _expires = leased
+                metrics.count("server.job.leased")
+        else:
+            job = self.clerking_job_store.poll_clerking_job(clerk)
         metrics.count("server.job.polled" if job else "server.job.poll_empty")
         return job
 

@@ -31,9 +31,12 @@ import json
 import os
 import tempfile
 import threading
+import time
 from pathlib import Path
 from typing import List, Optional
 
+from .. import chaos
+from ..utils import metrics
 from ..protocol import (
     Agent,
     Aggregation,
@@ -204,6 +207,7 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
             )
 
     def create_participation(self, participation):
+        chaos.fail("store.create_participation")
         with self._lock:
             if self.get_aggregation(participation.aggregation) is None:
                 raise NotFound("aggregation not found")
@@ -214,6 +218,7 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
             )
 
     def create_snapshot(self, snapshot):
+        chaos.fail("store.create_snapshot")
         with self._lock:
             _write_json(
                 self.root / "snapshots" / str(snapshot.aggregation) / f"{snapshot.id}.json",
@@ -241,6 +246,11 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
         with self._lock:
             part_ids = _ids_in(self.root / "participations" / str(aggregation))
             _write_json(self.root / "snapshot_parts" / f"{snapshot}.json", part_ids)
+
+    def has_snapshot_freeze(self, aggregation, snapshot):
+        with self._lock:
+            # the frozen-id file is the durable marker (an empty list counts)
+            return (self.root / "snapshot_parts" / f"{snapshot}.json").exists()
 
     def count_participations_snapshot(self, aggregation, snapshot):
         # the frozen id list already holds the answer — don't deserialize
@@ -275,18 +285,40 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
 
 class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
     def enqueue_clerking_job(self, job):
+        chaos.fail("store.enqueue_clerking_job")
         with self._lock:
+            if (self.root / "done" / str(job.clerk) / f"{job.id}.json").exists():
+                return  # snapshot retry: this job already completed
             _write_json(
                 self.root / "queue" / str(job.clerk) / f"{job.id}.json", job.to_obj()
             )
 
     def poll_clerking_job(self, clerk):
+        chaos.fail("store.poll_clerking_job")
         with self._lock:
             ids = _ids_in(self.root / "queue" / str(clerk))
             if not ids:
                 return None
             obj = _read_json(self.root / "queue" / str(clerk) / f"{ids[0]}.json")
             return ClerkingJob.from_obj(obj)
+
+    def lease_clerking_job(self, clerk, lease_seconds, now=None):
+        chaos.fail("store.poll_clerking_job")
+        now = time.time() if now is None else now
+        with self._lock:
+            qdir = self.root / "queue" / str(clerk)
+            # lease files are dot-prefixed so _ids_in never mistakes one
+            # for a queued job; they survive restarts like everything else
+            for job_id in _ids_in(qdir):
+                lease = _read_json(qdir / f".lease-{job_id}.json")
+                if lease is not None and lease["expires"] > now:
+                    continue  # actively leased by another worker
+                if lease is not None:
+                    metrics.count("server.job.reissued")
+                expires = now + lease_seconds
+                _write_json(qdir / f".lease-{job_id}.json", {"expires": expires})
+                return ClerkingJob.from_obj(_read_json(qdir / f"{job_id}.json")), expires
+            return None
 
     def get_clerking_job(self, clerk, job):
         with self._lock:
@@ -297,6 +329,7 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
             return None
 
     def create_clerking_result(self, result):
+        chaos.fail("store.create_clerking_result")
         with self._lock:
             queue_path = self.root / "queue" / str(result.clerk) / f"{result.job}.json"
             obj = _read_json(queue_path)
@@ -311,6 +344,7 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
             )
             _write_json(self.root / "done" / str(result.clerk) / f"{job.id}.json", obj)
             queue_path.unlink(missing_ok=True)
+            queue_path.with_name(f".lease-{result.job}.json").unlink(missing_ok=True)
 
     def list_results(self, snapshot):
         with self._lock:

@@ -8,9 +8,12 @@ simulated-pod runs where the server is pure control plane.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from .. import chaos
+from ..utils import metrics
 from ..protocol import (
     Agent,
     AgentId,
@@ -154,6 +157,7 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
             self._committees[committee.aggregation] = committee
 
     def create_participation(self, participation):
+        chaos.fail("store.create_participation")
         with self._lock:
             if participation.aggregation not in self._aggregations:
                 raise NotFound("aggregation not found")
@@ -161,6 +165,7 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
             self._participations[participation.aggregation][participation.id] = participation
 
     def create_snapshot(self, snapshot):
+        chaos.fail("store.create_snapshot")
         with self._lock:
             self._snapshots[snapshot.aggregation][snapshot.id] = snapshot
 
@@ -181,6 +186,10 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
             self._snapshot_parts[snapshot] = list(
                 self._participations.get(aggregation, OrderedDict())
             )
+
+    def has_snapshot_freeze(self, aggregation, snapshot):
+        with self._lock:
+            return snapshot in self._snapshot_parts  # even when frozen empty
 
     def iter_snapped_participations(self, aggregation, snapshot):
         with self._lock:
@@ -204,17 +213,37 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
         self._queues: Dict[AgentId, OrderedDict] = {}
         self._done: Dict[AgentId, Dict[ClerkingJobId, ClerkingJob]] = {}
         self._results: Dict[SnapshotId, OrderedDict] = {}
+        self._leases: Dict[ClerkingJobId, float] = {}  # job id -> expires_at
 
     def enqueue_clerking_job(self, job):
+        chaos.fail("store.enqueue_clerking_job")
         with self._lock:
+            if job.id in self._done.get(job.clerk, {}):
+                return  # snapshot retry: this job already completed
             self._queues.setdefault(job.clerk, OrderedDict())[job.id] = job
 
     def poll_clerking_job(self, clerk):
+        chaos.fail("store.poll_clerking_job")
         with self._lock:
             queue = self._queues.get(clerk)
             if not queue:
                 return None
             return next(iter(queue.values()))
+
+    def lease_clerking_job(self, clerk, lease_seconds, now=None):
+        chaos.fail("store.poll_clerking_job")
+        now = time.time() if now is None else now
+        with self._lock:
+            for job in self._queues.get(clerk, OrderedDict()).values():
+                expiry = self._leases.get(job.id)
+                if expiry is not None and expiry > now:
+                    continue  # actively leased by another worker of this clerk
+                if expiry is not None:
+                    metrics.count("server.job.reissued")
+                expires = now + lease_seconds
+                self._leases[job.id] = expires
+                return job, expires
+            return None
 
     def get_clerking_job(self, clerk, job):
         with self._lock:
@@ -224,12 +253,14 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
             return found
 
     def create_clerking_result(self, result):
+        chaos.fail("store.create_clerking_result")
         with self._lock:
             queue = self._queues.get(result.clerk, OrderedDict())
             job = queue.pop(result.job, None)
             if job is None and result.job not in self._done.get(result.clerk, {}):
                 raise NotFound("job not found for clerk")
             if job is not None:
+                self._leases.pop(job.id, None)
                 self._done.setdefault(result.clerk, {})[job.id] = job
                 self._results.setdefault(job.snapshot, OrderedDict())[result.job] = result
 

@@ -17,7 +17,11 @@ deployment is present, point ``sdad --mongo URI`` at it.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
+
+from .. import chaos
+from ..utils import metrics
 
 try:  # driver not baked into this image; gate, don't fail at import
     import pymongo
@@ -169,6 +173,7 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         snap_ids = [d["_id"] for d in self.db.snapshots.find({"aggregation": agg})]
         if snap_ids:
             self.db.snapshot_masks.delete_many({"_id": {"$in": snap_ids}})
+            self.db.snapshot_freezes.delete_many({"_id": {"$in": snap_ids}})
         self.db.participations.delete_many({"aggregation": agg})
         self.db.snapshots.delete_many({"aggregation": agg})
         self.db.committees.delete_one({"_id": agg})
@@ -186,6 +191,7 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         )
 
     def create_participation(self, participation):
+        chaos.fail("store.create_participation")
         if self.get_aggregation(participation.aggregation) is None:
             raise NotFound("aggregation not found")
         self.db.participations.replace_one(
@@ -200,6 +206,7 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         )
 
     def create_snapshot(self, snapshot):
+        chaos.fail("store.create_snapshot")
         self.db.snapshots.replace_one(
             {"_id": str(snapshot.id)},
             {
@@ -229,11 +236,23 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         )
 
     def snapshot_participations(self, aggregation, snapshot):
-        # the reference's $addToSet freeze (aggregations.rs:132-142)
+        # the reference's $addToSet freeze (aggregations.rs:132-142); the
+        # marker doc records the freeze durably even when the set is empty.
+        # Marker LAST is the correct commit point: jobs/masks are only
+        # built after the freeze returns, so a crash between the two
+        # writes leaves nothing that consumed the half-frozen set — the
+        # replay re-runs the idempotent $addToSet (possibly widening the
+        # set) and every downstream consumer sees that one final set.
         self.db.participations.update_many(
             {"aggregation": str(aggregation)},
             {"$addToSet": {"snapshots": str(snapshot)}},
         )
+        self.db.snapshot_freezes.replace_one(
+            {"_id": str(snapshot)}, {"_id": str(snapshot)}, upsert=True
+        )
+
+    def has_snapshot_freeze(self, aggregation, snapshot):
+        return self.db.snapshot_freezes.find_one({"_id": str(snapshot)}) is not None
 
     def count_participations_snapshot(self, aggregation, snapshot):
         return self.db.participations.count_documents(
@@ -264,29 +283,59 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
 
 class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
     def enqueue_clerking_job(self, job):
-        self.db.clerking_jobs.replace_one(
-            {"_id": str(job.id)},
-            {
-                "_id": str(job.id),
-                "clerk": str(job.clerk),
-                "snapshot": str(job.snapshot),
-                "done": False,
-                "doc": job.to_obj(),
-            },
-            upsert=True,
+        chaos.fail("store.enqueue_clerking_job")
+        payload = {
+            "_id": str(job.id),
+            "clerk": str(job.clerk),
+            "snapshot": str(job.snapshot),
+            "done": False,
+            "doc": job.to_obj(),
+        }
+        # refresh only a still-QUEUED job; a snapshot replay must never
+        # resurrect a done job or wipe its embedded result
+        res = self.db.clerking_jobs.replace_one(
+            {"_id": str(job.id), "done": False}, payload
         )
+        if res.matched_count == 0:
+            self.db.clerking_jobs.update_one(
+                {"_id": str(job.id)}, {"$setOnInsert": payload}, upsert=True
+            )
 
     def poll_clerking_job(self, clerk):
+        chaos.fail("store.poll_clerking_job")
         doc = self.db.clerking_jobs.find_one(
             {"clerk": str(clerk), "done": False}, sort=[("_id", 1)]
         )
         return None if doc is None else ClerkingJob.from_obj(doc["doc"])
+
+    def lease_clerking_job(self, clerk, lease_seconds, now=None):
+        chaos.fail("store.poll_clerking_job")
+        now = time.time() if now is None else now
+        expires = now + lease_seconds
+        doc = self.db.clerking_jobs.find_one_and_update(
+            {
+                "clerk": str(clerk),
+                "done": False,
+                "$or": [
+                    {"leased_until": {"$exists": False}},
+                    {"leased_until": {"$lte": now}},
+                ],
+            },
+            {"$set": {"leased_until": expires}},
+            sort=[("_id", 1)],
+        )
+        if doc is None:
+            return None
+        if doc.get("leased_until") is not None:
+            metrics.count("server.job.reissued")
+        return ClerkingJob.from_obj(doc["doc"]), expires
 
     def get_clerking_job(self, clerk, job):
         doc = self.db.clerking_jobs.find_one({"_id": str(job), "clerk": str(clerk)})
         return None if doc is None else ClerkingJob.from_obj(doc["doc"])
 
     def create_clerking_result(self, result):
+        chaos.fail("store.create_clerking_result")
         # ONE atomic single-document update sets the result and flips done —
         # a crash can never consume the job without storing the result (the
         # reference's clerking_jobs.rs create_clerking_result does the same

@@ -22,6 +22,7 @@ at; Sodium aggregations are untouched since sealed boxes don't compose.
 from __future__ import annotations
 
 import logging
+import uuid
 
 from ..protocol import (
     ClerkingJob,
@@ -33,6 +34,17 @@ from ..protocol import (
 from ..utils import metrics, timed_phase
 
 log = logging.getLogger(__name__)
+
+#: Namespace for deterministic clerking-job ids (uuid5 over snapshot:clerk).
+_JOB_NAMESPACE = uuid.UUID("6ad33932-6a4c-4745-a2b4-11e89e7206ad")
+
+
+def clerking_job_id(snapshot_id, clerk_id) -> ClerkingJobId:
+    """Deterministic job id for (snapshot, clerk) — re-running the snapshot
+    pipeline (a retried POST after a lost response, a crash-resume replay)
+    upserts the SAME jobs instead of enqueueing duplicates, which is what
+    makes snapshot creation safe for the retrying transport."""
+    return ClerkingJobId(uuid.uuid5(_JOB_NAMESPACE, f"{snapshot_id}:{clerk_id}"))
 
 
 def _premix_columns(server, aggregation, committee, columns):
@@ -70,13 +82,37 @@ def _premix_columns(server, aggregation, committee, columns):
     return mixed
 
 
-def snapshot(server, snap: Snapshot) -> None:
+def snapshot(server, snap: Snapshot) -> bool:
+    # the whole pipeline is serialized: a timed-out client retry arriving
+    # while the original is still running must wait and then hit the
+    # existence check, not race the freeze/enqueue (snapshot creation is
+    # a rare control-plane operation; the lock costs nothing that matters)
+    with server._snapshot_lock:
+        return _snapshot_locked(server, snap)
+
+
+def _snapshot_locked(server, snap: Snapshot) -> bool:
     aggregation = server.aggregation_store.get_aggregation(snap.aggregation)
     if aggregation is None:
         raise NotFound("lost aggregation")
+    if server.aggregation_store.get_snapshot(snap.aggregation, snap.id) is not None:
+        # create-once: the snapshot record is written last (below), so its
+        # presence proves the whole pipeline already ran — a retry is a no-op
+        log.debug("snapshot %s: already exists, skipping", snap.id)
+        metrics.count("server.snapshot.duplicate")
+        return False
     log.debug("snapshot %s: freezing participations", snap.id)
     with timed_phase("server.snapshot_freeze"):
-        server.aggregation_store.snapshot_participations(snap.aggregation, snap.id)
+        # first-write-wins: a crash-replay (record not yet committed, but
+        # jobs possibly enqueued and even clerked) must re-use the
+        # ORIGINAL frozen set — re-freezing after a late participation
+        # would mix share generations across clerk columns
+        if not server.aggregation_store.has_snapshot_freeze(
+            snap.aggregation, snap.id
+        ):
+            server.aggregation_store.snapshot_participations(
+                snap.aggregation, snap.id
+            )
 
     committee = server.get_committee(snap.aggregation)
     if committee is None:
@@ -104,15 +140,13 @@ def snapshot(server, snap: Snapshot) -> None:
         for (clerk_id, _), encryptions in zip(committee.clerks_and_keys, columns):
             server.clerking_job_store.enqueue_clerking_job(
                 ClerkingJob(
-                    id=ClerkingJobId.random(),
+                    id=clerking_job_id(snap.id, clerk_id),
                     clerk=clerk_id,
                     aggregation=snap.aggregation,
                     snapshot=snap.id,
                     encryptions=encryptions,
                 )
             )
-
-    server.aggregation_store.create_snapshot(snap)
 
     if aggregation.masking_scheme.has_mask:
         log.debug("snapshot %s: collecting recipient mask encryptions", snap.id)
@@ -125,4 +159,13 @@ def snapshot(server, snap: Snapshot) -> None:
             recipient_encryptions.append(participation.recipient_encryption)
         server.aggregation_store.create_snapshot_mask(snap.id, recipient_encryptions)
 
+    # the snapshot record is the commit point and therefore goes LAST:
+    # its presence proves jobs and masks are durable, so the existence
+    # check above can safely short-circuit a retried create. A crash
+    # mid-pipeline leaves no record and the retry re-runs everything —
+    # job ids are deterministic, so the re-run upserts instead of
+    # duplicating.
+    server.aggregation_store.create_snapshot(snap)
+
     log.debug("snapshot %s: done", snap.id)
+    return True

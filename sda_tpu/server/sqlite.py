@@ -28,8 +28,11 @@ from __future__ import annotations
 import json
 import sqlite3
 import threading
+import time
 from typing import List
 
+from .. import chaos
+from ..utils import metrics
 from ..protocol import (
     Agent,
     AgentId,
@@ -81,11 +84,14 @@ CREATE TABLE IF NOT EXISTS snapshots (
 CREATE TABLE IF NOT EXISTS snapshot_parts (
     snapshot TEXT NOT NULL, participation TEXT NOT NULL,
     PRIMARY KEY (snapshot, participation));
+CREATE TABLE IF NOT EXISTS snapshot_freezes (
+    snapshot TEXT PRIMARY KEY);
 CREATE TABLE IF NOT EXISTS snapshot_masks (
     snapshot TEXT PRIMARY KEY, doc TEXT NOT NULL);
 CREATE TABLE IF NOT EXISTS clerking_jobs (
     id TEXT NOT NULL, clerk TEXT NOT NULL, snapshot TEXT NOT NULL,
-    done INTEGER NOT NULL DEFAULT 0, doc TEXT NOT NULL,
+    done INTEGER NOT NULL DEFAULT 0, leased_until REAL NOT NULL DEFAULT 0,
+    doc TEXT NOT NULL,
     PRIMARY KEY (clerk, id));
 CREATE INDEX IF NOT EXISTS ix_jobs_queue ON clerking_jobs (clerk, done, id);
 CREATE TABLE IF NOT EXISTS clerking_results (
@@ -105,6 +111,16 @@ class SqliteDb:
             if self.path != ":memory:":
                 self.conn.execute("PRAGMA journal_mode=WAL")
             self.conn.executescript(_SCHEMA)
+            # migrate pre-lease databases: CREATE IF NOT EXISTS won't add
+            # the column to an existing clerking_jobs table
+            cols = {
+                r[1] for r in self.conn.execute("PRAGMA table_info(clerking_jobs)")
+            }
+            if "leased_until" not in cols:
+                self.conn.execute(
+                    "ALTER TABLE clerking_jobs "
+                    "ADD COLUMN leased_until REAL NOT NULL DEFAULT 0"
+                )
 
     def ping(self) -> None:
         with self.lock:
@@ -231,7 +247,7 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
     def delete_aggregation(self, aggregation):
         agg = str(aggregation)
         with self.db.lock, self.db.conn:
-            for table in ("snapshot_parts", "snapshot_masks"):
+            for table in ("snapshot_parts", "snapshot_masks", "snapshot_freezes"):
                 self.db.conn.execute(
                     f"DELETE FROM {table} WHERE snapshot IN "
                     "(SELECT id FROM snapshots WHERE aggregation = ?)",
@@ -258,6 +274,7 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
         )
 
     def create_participation(self, participation):
+        chaos.fail("store.create_participation")
         with self.db.lock, self.db.conn:
             exists = self.db.conn.execute(
                 "SELECT 1 FROM aggregations WHERE id = ?",
@@ -276,6 +293,7 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
             )
 
     def create_snapshot(self, snapshot):
+        chaos.fail("store.create_snapshot")
         self._exec(
             "INSERT INTO snapshots (id, aggregation, doc) VALUES (?, ?, ?) "
             "ON CONFLICT (aggregation, id) DO UPDATE SET doc = excluded.doc",
@@ -308,13 +326,25 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
         return row[0]
 
     def snapshot_participations(self, aggregation, snapshot):
-        # the $addToSet moment: freeze exactly the rows present now
+        # the $addToSet moment: freeze exactly the rows present now; the
+        # marker row (same transaction) records the freeze durably even
+        # when the frozen set is empty
         with self.db.lock, self.db.conn:
             self.db.conn.execute(
                 "INSERT OR IGNORE INTO snapshot_parts (snapshot, participation) "
                 "SELECT ?, id FROM participations WHERE aggregation = ?",
                 (str(snapshot), str(aggregation)),
             )
+            self.db.conn.execute(
+                "INSERT OR IGNORE INTO snapshot_freezes (snapshot) VALUES (?)",
+                (str(snapshot),),
+            )
+
+    def has_snapshot_freeze(self, aggregation, snapshot):
+        row = self._one(
+            "SELECT 1 FROM snapshot_freezes WHERE snapshot = ?", (str(snapshot),)
+        )
+        return row is not None
 
     def count_participations_snapshot(self, aggregation, snapshot):
         row = self._one(
@@ -349,10 +379,16 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
 
 class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
     def enqueue_clerking_job(self, job):
+        chaos.fail("store.enqueue_clerking_job")
+        # upsert keyed by (clerk, id); the conflict clause deliberately
+        # leaves done/leased_until alone — and refuses to touch a DONE
+        # job's payload at all — so a snapshot retry can't resurrect,
+        # un-lease, or rewrite completed work
         self._exec(
             "INSERT INTO clerking_jobs (id, clerk, snapshot, done, doc) "
             "VALUES (?, ?, ?, 0, ?) "
-            "ON CONFLICT (clerk, id) DO UPDATE SET doc = excluded.doc",
+            "ON CONFLICT (clerk, id) DO UPDATE SET doc = excluded.doc "
+            "WHERE clerking_jobs.done = 0",
             (
                 str(job.id),
                 str(job.clerk),
@@ -362,12 +398,36 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
         )
 
     def poll_clerking_job(self, clerk):
+        chaos.fail("store.poll_clerking_job")
         row = self._one(
             "SELECT doc FROM clerking_jobs WHERE clerk = ? AND done = 0 "
             "ORDER BY id LIMIT 1",
             (str(clerk),),
         )
         return None if row is None else ClerkingJob.from_obj(json.loads(row[0]))
+
+    def lease_clerking_job(self, clerk, lease_seconds, now=None):
+        chaos.fail("store.poll_clerking_job")
+        now = time.time() if now is None else now
+        with self.db.lock, self.db.conn:
+            row = self.db.conn.execute(
+                "SELECT id, doc, leased_until FROM clerking_jobs "
+                "WHERE clerk = ? AND done = 0 AND leased_until <= ? "
+                "ORDER BY id LIMIT 1",
+                (str(clerk), now),
+            ).fetchone()
+            if row is None:
+                return None
+            job_id, doc, previous = row
+            if previous > 0:
+                metrics.count("server.job.reissued")
+            expires = now + lease_seconds
+            self.db.conn.execute(
+                "UPDATE clerking_jobs SET leased_until = ? "
+                "WHERE clerk = ? AND id = ?",
+                (expires, str(clerk), job_id),
+            )
+            return ClerkingJob.from_obj(json.loads(doc)), expires
 
     def get_clerking_job(self, clerk, job):
         row = self._one(
@@ -377,6 +437,7 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
         return None if row is None else ClerkingJob.from_obj(json.loads(row[0]))
 
     def create_clerking_result(self, result):
+        chaos.fail("store.create_clerking_result")
         # result write + done-flag flip, atomically (the Mongo store's
         # done-flag queue semantics, clerking_jobs.rs:32-75)
         with self.db.lock, self.db.conn:

@@ -12,6 +12,7 @@ server-store-mongodb/src/aggregations.rs:164-195).
 from __future__ import annotations
 
 import abc
+import time
 from typing import Iterable, List, Optional, Tuple
 
 from ..protocol import (
@@ -131,6 +132,17 @@ class AggregationsStore(BaseStore):
         """Freeze the current participation set under the snapshot id — the
         consistency point that keeps late arrivals out of a running round."""
 
+    def has_snapshot_freeze(
+        self, aggregation: AggregationId, snapshot: SnapshotId
+    ) -> bool:
+        """Whether ``snapshot_participations`` already ran for this
+        snapshot. The snapshot pipeline's first-write-wins replay guard:
+        a frozen-but-EMPTY set must read as frozen, or a crash-replay
+        with a late participation would re-freeze a superset. Backends
+        should override with a durable marker; this fallback (count > 0)
+        cannot tell frozen-empty from unfrozen."""
+        return self.count_participations_snapshot(aggregation, snapshot) > 0
+
     @abc.abstractmethod
     def iter_snapped_participations(
         self, aggregation: AggregationId, snapshot: SnapshotId
@@ -163,10 +175,34 @@ class AggregationsStore(BaseStore):
 
 class ClerkingJobsStore(BaseStore):
     @abc.abstractmethod
-    def enqueue_clerking_job(self, job: ClerkingJob) -> None: ...
+    def enqueue_clerking_job(self, job: ClerkingJob) -> None:
+        """Queue a job for its clerk. Must be an upsert keyed by
+        ``(clerk, id)`` and must NOT resurrect a completed job — snapshot
+        creation relies on this to be retry-idempotent."""
 
     @abc.abstractmethod
-    def poll_clerking_job(self, clerk: AgentId) -> Optional[ClerkingJob]: ...
+    def poll_clerking_job(self, clerk: AgentId) -> Optional[ClerkingJob]:
+        """Peek the clerk's next undone job (reference semantics: the job
+        stays visible until its result lands)."""
+
+    def lease_clerking_job(
+        self, clerk: AgentId, lease_seconds: float, now: Optional[float] = None
+    ) -> Optional[Tuple[ClerkingJob, float]]:
+        """Pull the clerk's next undone job that is not under an active
+        lease and stamp a new lease on it; returns ``(job, expires_at)``.
+
+        A lease is a visibility timeout (the SQS model): while held, other
+        pollers of the same clerk identity get the NEXT job instead of
+        duplicating this one; once it expires without a result the job is
+        *reissued* — returned again to whichever live poller asks first
+        (``server.job.reissued``). Backends without native lease support
+        inherit this fallback, which degrades to the plain visible-poll.
+        """
+        job = self.poll_clerking_job(clerk)
+        if job is None:
+            return None
+        now = time.time() if now is None else now
+        return job, now + lease_seconds
 
     @abc.abstractmethod
     def get_clerking_job(

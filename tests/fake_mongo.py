@@ -1,11 +1,12 @@
 """Minimal in-memory pymongo-compatible fake for exercising the Mongo store.
 
-Implements exactly the surface sda_tpu.server.mongo uses — replace_one
-(upsert), find/find_one with sorts, delete_one/many, update_many with
-$addToSet, count_documents, find_one_and_update with $set — including
-Mongo's array-field equality semantics ({"snapshots": "x"} matches
-documents whose ``snapshots`` list contains "x"). Lets the whole store
-test suite run without a mongod; a real deployment uses pymongo.
+Implements exactly the surface sda_tpu.server.mongo uses — replace_one /
+update_one (upsert, $setOnInsert, matched_count), find/find_one with
+sorts, delete_one/many, update_many with $addToSet, count_documents,
+find_one_and_update with $set and sort, $or and range operators —
+including Mongo's array-field equality semantics ({"snapshots": "x"}
+matches documents whose ``snapshots`` list contains "x"). Lets the whole
+store test suite run without a mongod; a real deployment uses pymongo.
 """
 
 from __future__ import annotations
@@ -17,6 +18,10 @@ from typing import Any, Dict, List, Optional
 
 def _matches(doc: Dict[str, Any], query: Dict[str, Any]) -> bool:
     for field, cond in query.items():
+        if field == "$or":
+            if not any(_matches(doc, sub) for sub in cond):
+                return False
+            continue
         value = doc.get(field)
         if isinstance(cond, dict):
             for op, arg in cond.items():
@@ -30,6 +35,17 @@ def _matches(doc: Dict[str, Any], query: Dict[str, Any]) -> bool:
                         return False
                 elif op == "$exists":
                     if (field in doc) != bool(arg):
+                        return False
+                elif op in ("$lte", "$lt", "$gte", "$gt"):
+                    if value is None:
+                        return False
+                    if op == "$lte" and not value <= arg:
+                        return False
+                    if op == "$lt" and not value < arg:
+                        return False
+                    if op == "$gte" and not value >= arg:
+                        return False
+                    if op == "$gt" and not value > arg:
                         return False
                 else:
                     raise NotImplementedError(f"fake_mongo: operator {op}")
@@ -50,8 +66,15 @@ def _apply_update(doc: Dict[str, Any], update: Dict[str, Any]) -> None:
                 arr = doc.setdefault(field, [])
                 if item not in arr:
                     arr.append(item)
+        elif op == "$setOnInsert":
+            pass  # applies only on upsert-insert, handled by update_one
         else:
             raise NotImplementedError(f"fake_mongo: update op {op}")
+
+
+class _UpdateResult:
+    def __init__(self, matched_count: int):
+        self.matched_count = matched_count
 
 
 class _Cursor:
@@ -81,13 +104,31 @@ class FakeCollection:
         return [d for d in self._docs.values() if _matches(d, query)]
 
     def replace_one(self, filter: Dict[str, Any], doc: Dict[str, Any],
-                    upsert: bool = False):
+                    upsert: bool = False) -> _UpdateResult:
         with self._lock:
             found = self._find(filter)
             if found:
                 self._docs[found[0]["_id"]] = copy.deepcopy(doc)
             elif upsert:
                 self._docs[doc["_id"]] = copy.deepcopy(doc)
+            return _UpdateResult(matched_count=len(found[:1]))
+
+    def update_one(self, filter: Dict[str, Any], update: Dict[str, Any],
+                   upsert: bool = False) -> _UpdateResult:
+        with self._lock:
+            found = self._find(filter)
+            if found:
+                _apply_update(self._docs[found[0]["_id"]], update)
+                return _UpdateResult(matched_count=1)
+            if upsert:
+                # insert path: $setOnInsert fields apply, plus filter _id
+                doc = dict(update.get("$setOnInsert", {}))
+                if "_id" in filter and "_id" not in doc:
+                    doc["_id"] = filter["_id"]
+                _apply_update(doc, {k: v for k, v in update.items()
+                                    if k != "$setOnInsert"})
+                self._docs[doc["_id"]] = copy.deepcopy(doc)
+            return _UpdateResult(matched_count=0)
 
     def find_one(self, query: Dict[str, Any], sort=None) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -119,12 +160,15 @@ class FakeCollection:
         with self._lock:
             return len(self._find(query))
 
-    def find_one_and_update(self, query: Dict[str, Any], update: Dict[str, Any]):
+    def find_one_and_update(self, query: Dict[str, Any], update: Dict[str, Any],
+                            sort=None):
         """Returns the PRE-update document (pymongo default), atomically."""
         with self._lock:
             found = self._find(query)
             if not found:
                 return None
+            if sort:
+                found = list(_Cursor(found).sort(sort)._docs)
             doc = found[0]
             before = copy.deepcopy(doc)
             _apply_update(self._docs[doc["_id"]], update)
