@@ -12,6 +12,21 @@ python -m pytest tests/ -q -m 'not chaos'
 echo "== chaos (fault injection under a fixed seed: failpoints, retry, lease/reissue)"
 env SDA_CHAOS_SEED=20260803 python -m pytest tests/ -q -m chaos
 
+echo "== loadgen smoke (fixed seed, closed-loop, zero 5xx, histogram report)"
+LOAD_REPORT=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 24 --dim 4 \
+  --load-arrivals closed --load-concurrency 8 --load-seed 20260803)
+LOAD_REPORT="$LOAD_REPORT" python - <<'PY'
+import json, os
+report = json.loads(os.environ["LOAD_REPORT"].strip().splitlines()[-1])
+assert report["ready"] and report["exact"], report
+assert report["client_failures"] == 0, report
+assert report["errors_5xx"] == 0, report["status_counts"]
+assert report["latency_ms"], "empty per-route histogram report"
+assert report["phases_ms"], "empty phase histogram report"
+print(f"loadgen smoke OK: {report['load_requests']} load-phase requests, "
+      f"{report['sustained_rps']} rps sustained")
+PY
+
 echo "== CLI walkthrough (real sdad + sda over HTTP)"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu bash docs/walkthrough.sh | tail -1 | {
   read -r reveal

@@ -71,7 +71,7 @@ def run_chaos_drill(
         prime_modulus=433, omega_secrets=354, omega_shares=150,
     )
 
-    metrics.reset_counters()
+    metrics.reset_all()
     chaos.reset()
 
     if store == "memory":
@@ -187,6 +187,8 @@ def run_chaos_drill(
         chaos.reset()
         http_server.shutdown()
 
+    from ..loadgen import latency_report_ms as _latency_report_ms
+
     counters = metrics.counter_report()
     injected = sum(v for k, v in counters.items() if k.startswith("chaos."))
     # request-level failure accounting: dispatch 500s and store faults are
@@ -217,5 +219,8 @@ def run_chaos_drill(
             if k.startswith(("chaos.", "http.retry.", "http.status.",
                              "server.job.", "server.snapshot."))
         },
+        # per-route server latency under fire: the tail the retry budget
+        # has to ride out (loadgen measures the same table under load)
+        "latency_ms": _latency_report_ms(),
     }
     return report

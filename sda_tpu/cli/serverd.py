@@ -29,6 +29,21 @@ def build_parser() -> argparse.ArgumentParser:
                              "jobs are invisible to the clerk's other "
                              "workers and reissued after expiry (default: "
                              "reference visible-poll semantics)")
+    parser.add_argument("--metrics", action="store_true",
+                        help="serve Prometheus text exposition (counters + "
+                             "latency histogram buckets) at GET /metrics "
+                             "(off by default)")
+    parser.add_argument("--max-inflight", type=int, metavar="N", default=None,
+                        help="admission control: shed requests with 503 + "
+                             "Retry-After beyond N concurrently in flight "
+                             "(default: unbounded)")
+    parser.add_argument("--rate-limit", type=float, metavar="RPS", default=None,
+                        help="admission control: per-agent token-bucket "
+                             "rate; overflow sheds 429 + Retry-After "
+                             "before any crypto or store work "
+                             "(default: unlimited)")
+    parser.add_argument("--rate-burst", type=float, metavar="N", default=8.0,
+                        help="token-bucket burst capacity per agent")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     sub = parser.add_subparsers(dest="command", required=True)
     httpd = sub.add_parser("httpd")
@@ -63,7 +78,13 @@ def main(argv=None) -> int:
     if args.job_lease is not None:
         service.server.clerking_lease_seconds = args.job_lease
 
-    server = SdaHttpServer(service, bind=args.bind)
+    server = SdaHttpServer(
+        service, bind=args.bind,
+        max_inflight=args.max_inflight,
+        rate_limit=args.rate_limit,
+        rate_burst=args.rate_burst,
+        metrics_endpoint=args.metrics,
+    )
     print(f"sdad listening on {server.address}", flush=True)
     try:
         server.serve_forever()

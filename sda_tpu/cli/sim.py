@@ -8,6 +8,13 @@ line with timing and the verification verdict.
 
     sda-sim --participants 100 --dim 9999 --clerks 8
     sda-sim --participants 1000 --dim 3000000 --streaming
+
+Two no-JAX drill profiles exercise the serving plane instead of the
+kernels: ``--chaos`` (fault injection, chaos/drill.py) and ``--load``
+(capacity measurement + admission control, loadgen/driver.py):
+
+    sda-sim --load --participants 200 --load-rps 150
+    sda-sim --load --participants 200 --load-overload
 """
 
 from __future__ import annotations
@@ -46,6 +53,42 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--pallas", action="store_true",
                         help="fused Pallas local step (packed-Shamir x "
                              "Solinas x none/full masking; TPU)")
+    parser.add_argument("--load", action="store_true",
+                        help="capacity profile: drive N simulated "
+                             "participants through a full round over real "
+                             "HTTP (open-loop Poisson or closed-loop) and "
+                             "print the capacity report (sustained RPS, "
+                             "p50/p95/p99 per route, shed/retry rates)")
+    parser.add_argument("--load-arrivals", choices=["open", "closed"],
+                        default="open",
+                        help="workload model: open-loop seeded Poisson "
+                             "arrivals at --load-rps, or closed-loop "
+                             "request-after-request (--load)")
+    parser.add_argument("--load-rps", type=float, default=100.0,
+                        help="open-loop participant arrival rate (--load)")
+    parser.add_argument("--load-concurrency", type=int, default=32,
+                        help="worker threads driving participants (--load)")
+    parser.add_argument("--load-seed", type=int, default=0,
+                        help="arrival schedule + input seed (--load)")
+    parser.add_argument("--load-store", choices=["memory", "sqlite", "jsonfs"],
+                        default="memory",
+                        help="server store backend for --load")
+    parser.add_argument("--load-overload", action="store_true",
+                        help="forced overload profile: arm a tight "
+                             "per-agent token bucket so the server sheds "
+                             "with 429+Retry-After and clients must "
+                             "converge via retry (--load)")
+    parser.add_argument("--load-rate", type=float, default=None,
+                        help="per-agent admission rate, tokens/sec "
+                             "(--load; --load-overload presets 8)")
+    parser.add_argument("--load-burst", type=float, default=None,
+                        help="per-agent admission burst (--load; "
+                             "--load-overload presets 2)")
+    parser.add_argument("--load-max-inflight", type=int, default=None,
+                        help="bounded in-flight admission cap (--load)")
+    parser.add_argument("--load-chaos-rate", type=float, default=0.0,
+                        help="combined load+chaos drill: also 500 this "
+                             "fraction of requests (--load)")
     parser.add_argument("--chaos", action="store_true",
                         help="robustness profile: run a full federated "
                              "round over real HTTP with deterministic "
@@ -148,6 +191,53 @@ def _run_multihost(args, argv=None) -> int:
     return rc
 
 
+def _run_load(args) -> int:
+    """--load: the capacity drill — N simulated participants through a
+    full round over real HTTP (sda_tpu/loadgen/driver.py), reported as
+    one BENCH-style JSON line. No mesh/JAX involved: this profile
+    measures the transport/store/admission plane, not the kernels."""
+    import tempfile
+
+    from ..crypto import sodium
+    from ..loadgen import LoadProfile, run_load
+
+    if not sodium.available():
+        print("error: --load needs libsodium (real-crypto federated round)",
+              file=sys.stderr)
+        return 1
+    # load is about request volume, not payload mass: a CLI default dim of
+    # 9999 would turn every participation into a bulk-transfer benchmark
+    dim = min(args.dim, 64)
+    if dim != args.dim:
+        print(f"note: --load drills traffic, not payload size; clamping to "
+              f"--dim {dim}", file=sys.stderr)
+    rate, burst = args.load_rate, args.load_burst
+    if args.load_overload:
+        rate = 8.0 if rate is None else rate
+        burst = 2.0 if burst is None else burst
+    chaos_rate = args.load_chaos_rate or (args.chaos_rate if args.chaos else 0.0)
+    with tempfile.TemporaryDirectory() as tmp:
+        report = run_load(LoadProfile(
+            participants=args.participants,
+            dim=dim,
+            arrivals=args.load_arrivals,
+            target_rps=args.load_rps,
+            concurrency=args.load_concurrency,
+            seed=args.load_seed,
+            store=args.load_store,
+            store_path=None if args.load_store == "memory" else f"{tmp}/store",
+            max_inflight=args.load_max_inflight,
+            rate_limit=rate,
+            rate_burst=4.0 if burst is None else burst,
+            chaos_rate=chaos_rate,
+        ))
+    print(json.dumps(report))
+    ok = report["ready"] and report["exact"] and not report["client_failures"]
+    if chaos_rate == 0.0:
+        ok = ok and report["errors_5xx"] == 0
+    return 0 if ok else 1
+
+
 def _run_chaos(args) -> int:
     """--chaos: the robustness drill — a full federated round over real
     HTTP under deterministic fault injection (sda_tpu/chaos/drill.py),
@@ -194,6 +284,8 @@ def main(argv=None) -> int:
 
     configure_logging(args.verbose)
 
+    if args.load:
+        return _run_load(args)
     if args.chaos:
         return _run_chaos(args)
 

@@ -1,0 +1,180 @@
+"""Server-side admission control: shed load *before* doing work.
+
+SDA's broker faces "many weak, sporadic devices" (PAPER.md) — the overload
+failure mode is a retry storm from thousands of participants that drives a
+saturated server into collapse. Following the Tail-at-Scale playbook, the
+cheapest correct response is early rejection with an explicit come-back
+hint: a rejected request costs one header parse, an admitted one proceeds
+to auth/crypto/store work.
+
+Two independent guards, both optional (``None`` disables):
+
+- **per-agent token bucket** (``rate`` tokens/sec, ``burst`` capacity),
+  keyed by the Basic-auth username (the agent id) or, for unauthenticated
+  requests, the client address. Overflow sheds ``429`` with a
+  ``Retry-After`` hint computed from the bucket's actual refill time, so
+  a well-behaved client converges instead of hammering.
+- **bounded in-flight limiter** (``max_inflight`` concurrently handled
+  requests, process-wide). Overflow sheds ``503`` + a short ``Retry-After``
+  — the server is saturated regardless of who is asking.
+
+Decisions are counted under ``http.throttled.rate`` /
+``http.throttled.inflight``; the current and peak concurrency ride the
+``http.inflight`` / ``http.inflight.peak`` gauges (the queue-depth signal
+capacity reports key on).
+
+The handler MUST pair every admitted request with ``release()``
+(try/finally in ``_Handler._route``), or the in-flight counter leaks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..utils import metrics
+
+#: Prune idle per-agent buckets past this population (DoS hygiene: a churn
+#: of one-shot agent ids must not grow the dict without bound).
+_MAX_BUCKETS = 8192
+_BUCKET_IDLE_S = 300.0
+
+
+class TokenBucket:
+    """Classic token bucket; mutated under the owning controller's lock."""
+
+    __slots__ = ("rate", "burst", "tokens", "stamp")
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = float(rate)
+        # a burst below one token could never admit anything yet would
+        # keep emitting finite Retry-After hints — clamp the config
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst
+        self.stamp = now
+
+    def try_take(self, now: float) -> float:
+        """Take one token. Returns 0.0 on success, else the seconds until
+        a token will have accrued (the ``Retry-After`` hint)."""
+        elapsed = max(0.0, now - self.stamp)
+        self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
+        self.stamp = now
+        # epsilon: a client that honors the hint to the letter must not be
+        # re-shed over float rounding in the refill product
+        if self.tokens >= 1.0 - 1e-9:
+            self.tokens = max(0.0, self.tokens - 1.0)
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
+
+class ShedDecision:
+    """Why a request was rejected, and when to come back."""
+
+    __slots__ = ("status", "retry_after", "reason")
+
+    def __init__(self, status: int, retry_after: float, reason: str):
+        self.status = status
+        self.retry_after = retry_after
+        self.reason = reason
+
+
+class AdmissionControl:
+    """Combined rate-limit + concurrency guard for ``SdaHttpServer``.
+
+    Thread-safe; all knobs may be retuned at runtime via ``configure``
+    (the loadgen driver arms overload profiles after round setup).
+    """
+
+    def __init__(
+        self,
+        max_inflight: Optional[int] = None,
+        rate: Optional[float] = None,
+        burst: float = 8.0,
+    ):
+        self._lock = threading.Lock()
+        self.max_inflight = max_inflight
+        self.rate = rate
+        self.burst = burst
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._last_prune = 0.0
+        self._inflight = 0
+
+    def configure(
+        self,
+        max_inflight: Optional[int] = None,
+        rate: Optional[float] = None,
+        burst: Optional[float] = None,
+    ) -> None:
+        """REPLACE the whole admission config: each guard is set exactly
+        as passed (``None`` disables it; ``burst=None`` restores the
+        default) — no field survives a retune implicitly."""
+        with self._lock:
+            self.max_inflight = max_inflight
+            self.rate = rate
+            self.burst = 8.0 if burst is None else burst
+            self._buckets.clear()
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_inflight is not None or self.rate is not None
+
+    def admit(self, agent_key: str) -> Optional[ShedDecision]:
+        """Admit or shed one request. ``None`` = admitted (in-flight slot
+        taken; the caller owes a ``release()``); else the shed decision."""
+        now = time.monotonic()
+        with self._lock:
+            # concurrency first: an in-flight shed must not burn the
+            # agent's rate token (the retry would then need two)
+            if (
+                self.max_inflight is not None
+                and self._inflight >= self.max_inflight
+            ):
+                metrics.count("http.throttled.inflight")
+                # no queue to estimate from: hint one "typical request" out
+                return ShedDecision(503, 0.05, "server at max in-flight")
+            if self.rate is not None:
+                if self.rate <= 0.0:
+                    # "block everything" config: shed without a bucket
+                    # (a zero-rate bucket could never hand out a hint)
+                    metrics.count("http.throttled.rate")
+                    return ShedDecision(429, 1.0, "per-agent rate limit")
+                bucket = self._buckets.get(agent_key)
+                if bucket is None:
+                    if len(self._buckets) >= _MAX_BUCKETS:
+                        # hard bound even under fresh-key churn (the key is
+                        # an UNVERIFIED username): stale-sweep at most every
+                        # few seconds, otherwise evict the oldest-created
+                        # entry O(1) — an attacker minting usernames cycles
+                        # this dict, never grows it
+                        if now - self._last_prune > 5.0:
+                            self._last_prune = now
+                            cutoff = now - _BUCKET_IDLE_S
+                            for key in [k for k, b in self._buckets.items()
+                                        if b.stamp < cutoff]:
+                                del self._buckets[key]
+                        if len(self._buckets) >= _MAX_BUCKETS:
+                            del self._buckets[next(iter(self._buckets))]
+                    bucket = self._buckets[agent_key] = TokenBucket(
+                        self.rate, self.burst, now
+                    )
+                wait = bucket.try_take(now)
+                if wait > 0.0:
+                    metrics.count("http.throttled.rate")
+                    return ShedDecision(429, wait, "per-agent rate limit")
+            self._inflight += 1
+            depth = self._inflight
+        metrics.gauge_set("http.inflight", depth)
+        metrics.gauge_max("http.inflight.peak", depth)
+        return None
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+            depth = self._inflight
+        metrics.gauge_set("http.inflight", depth)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight

@@ -50,6 +50,34 @@ log = logging.getLogger(__name__)
 #: Statuses treated as transient server trouble — worth retrying.
 RETRYABLE_STATUSES = frozenset({500, 502, 503, 504})
 
+#: Admission-control shed (429 Too Many Requests): the server refused the
+#: request BEFORE doing any work, so retrying is always safe regardless of
+#: idempotence; the Retry-After hint says when.
+THROTTLED_STATUS = 429
+
+
+def _retry_after_seconds(response) -> Optional[float]:
+    """Parse a ``Retry-After`` header: delta-seconds (our server emits
+    fractional seconds) or an HTTP-date. ``None`` when absent/garbled."""
+    raw = response.headers.get("Retry-After")
+    if raw is None:
+        return None
+    raw = raw.strip()
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        pass
+    try:
+        from email.utils import parsedate_to_datetime
+        import datetime as _dt
+
+        when = parsedate_to_datetime(raw)
+        now = _dt.datetime.now(when.tzinfo or _dt.timezone.utc)
+        return max(0.0, (when - now).total_seconds())
+    except (TypeError, ValueError):
+        log.debug("ignoring unparseable Retry-After=%r", raw)
+        return None
+
 #: Every mutating route this client issues. All are POSTs whose server-side
 #: handlers are create-once / idempotent upserts keyed by a client-minted id
 #: (participations dedupe by participation id, results by (snapshot, job),
@@ -203,14 +231,16 @@ class SdaHttpClient(SdaService):
     def _request(self, method: str, path: str, *, params=None, json=None, auth=None):
         """One logical operation: exponential-backoff retries around the
         raw HTTP exchange, bounded by ``max_retries`` AND the
-        per-operation ``deadline``. Connection errors, timeouts, and
-        5xx responses are transient; everything else returns immediately
-        for ``_check`` to interpret."""
+        per-operation ``deadline``. Connection errors, timeouts, 5xx
+        responses, and 429 admission sheds are transient (a server
+        ``Retry-After`` hint overrides the jittered backoff, still capped
+        at the deadline); everything else returns immediately for
+        ``_check`` to interpret."""
         url = self.base_url + path
         give_up_at = _time.monotonic() + self.deadline
         attempt = 0
         while True:
-            cause, error = None, None
+            cause, error, retry_after = None, None, None
             # the deadline is a wall-clock budget: each attempt's socket
             # timeout is clamped to what remains (floored so the first
             # attempt always gets a chance even under a tiny deadline)
@@ -225,8 +255,13 @@ class SdaHttpClient(SdaService):
             except requests.ConnectionError as e:
                 cause, error = "connection", e
             else:
-                if response.status_code in RETRYABLE_STATUSES:
+                if response.status_code == THROTTLED_STATUS:
+                    # admission shed: nothing executed server-side
+                    cause = "status_429"
+                    retry_after = _retry_after_seconds(response)
+                elif response.status_code in RETRYABLE_STATUSES:
                     cause = "status_5xx"
+                    retry_after = _retry_after_seconds(response)
                 else:
                     if attempt:
                         metrics.count("http.retry.recovered")
@@ -239,9 +274,20 @@ class SdaHttpClient(SdaService):
                 return response  # terminal 5xx: let _check raise ServerError
             metrics.count("http.retry.attempt")
             metrics.count(f"http.retry.{cause}")
-            sleep = _random.uniform(
-                0.0, min(self.backoff_cap, self.backoff_base * (2 ** (attempt - 1)))
+            jitter = _random.uniform(
+                0.0,
+                min(self.backoff_cap, self.backoff_base * (2 ** (attempt - 1))),
             )
+            if retry_after is not None:
+                # the server told us when to come back: honor the hint,
+                # PLUS the growing jitter — early retries follow the hint
+                # closely (fast token-bucket convergence), persistent
+                # shedding still decays into exponential backoff instead
+                # of a cohort hammering at a constant hinted rate
+                metrics.count("http.retry.after_hint")
+                sleep = retry_after + jitter
+            else:
+                sleep = jitter
             sleep = min(sleep, max(0.0, give_up_at - _time.monotonic()))
             log.debug(
                 "%s %s transient failure (%s), retry %d/%d in %.3fs",

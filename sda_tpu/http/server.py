@@ -67,10 +67,47 @@ from ..protocol import (
 from ..server import SdaServerService, auth_token
 from ..utils import metrics
 from .. import chaos
+from .admission import AdmissionControl
 
 log = logging.getLogger(__name__)
 
 _ID = r"[0-9a-fA-F-]{36}"
+
+#: Every route template the dispatcher matches, ids collapsed to ``{id}``.
+#: Latency histograms are keyed by template (low cardinality by
+#: construction); anything else becomes ``unmatched`` so a scanner probing
+#: random paths cannot grow the histogram registry without bound.
+_ROUTE_TEMPLATES = frozenset({
+    "/v1/ping",
+    "/v1/agents/me",
+    "/v1/agents/{id}",
+    "/v1/agents/me/profile",
+    "/v1/agents/{id}/profile",
+    "/v1/agents/me/keys",
+    "/v1/agents/any/keys/{id}",
+    "/v1/aggregations",
+    "/v1/aggregations/{id}",
+    "/v1/aggregations/{id}/committee/suggestions",
+    "/v1/aggregations/implied/committee",
+    "/v1/aggregations/{id}/committee",
+    "/v1/aggregations/participations",
+    "/v1/aggregations/{id}/status",
+    "/v1/aggregations/implied/snapshot",
+    "/v1/aggregations/any/jobs",
+    "/v1/aggregations/implied/jobs/{id}/result",
+    "/v1/aggregations/{id}/snapshots/{id}/result",
+    "/metrics",
+})
+_ID_RE = re.compile(_ID)
+
+
+def route_label(method: str, path: str) -> str:
+    """``GET /v1/agents/3f2a... -> "GET:/v1/agents/{id}"`` — the
+    per-route key under ``http.latency.<route>``."""
+    template = _ID_RE.sub("{id}", path)
+    if template not in _ROUTE_TEMPLATES:
+        return f"{method}:unmatched"
+    return f"{method}:{template}"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -114,8 +151,12 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise InvalidRequest(f"malformed JSON body: {e}")
 
-    def _reply(self, status: int, obj=None, resource_not_found=False):
-        body = b"" if obj is None else json.dumps(obj).encode("utf-8")
+    def _reply(self, status: int, obj=None, resource_not_found=False,
+               retry_after=None, raw=None, content_type="application/json"):
+        if raw is not None:
+            body = raw
+        else:
+            body = b"" if obj is None else json.dumps(obj).encode("utf-8")
         # failpoint: the service call already happened — dropping HERE
         # simulates a lost response (side effect durable, client in the
         # dark), the exact hazard create-once retry semantics must absorb;
@@ -159,10 +200,26 @@ class _Handler(BaseHTTPRequestHandler):
                     counts[status] = counts.get(status, 0) + 1
             metrics.count("http.request")
             metrics.count(f"http.status.{status}")
+            if self._shed:
+                # an admission rejection is not a service latency: folding
+                # sub-ms sheds into the route histogram would collapse the
+                # reported tails exactly when overload makes them matter
+                metrics.observe("http.latency.shed", dt_ms / 1e3)
+            else:
+                label = route_label(
+                    self.command, getattr(self, "_route_path", None) or "/"
+                )
+                metrics.observe(f"http.latency.{label}", dt_ms / 1e3)
         self.send_response(status)
         if resource_not_found:
             self.send_header("X-Resource-Not-Found", "true")
-        self.send_header("Content-Type", "application/json")
+        if retry_after is not None:
+            # fractional seconds: RFC 9110 says integers, but both ends of
+            # this wire are ours and sub-second hints are what make the
+            # token-bucket convergence fast; foreign clients that int-parse
+            # still get a sane 0/1
+            self.send_header("Retry-After", f"{max(0.0, retry_after):.3f}")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -176,16 +233,62 @@ class _Handler(BaseHTTPRequestHandler):
     _t0 = 0.0
     _counted = False
     _body_consumed = False
+    _route_path = None
+    _shed = False
+
+    def _agent_key(self) -> str:
+        """Admission key: the CLAIMED agent id (token unverified — rate
+        limiting must not pay the auth lookup it protects), else the
+        client address for unauthenticated requests."""
+        creds = self._credentials()
+        if creds is not None:
+            return str(creds[0])
+        return str(self.client_address[0])
 
     # -- dispatch ----------------------------------------------------------
     def _route(self, method: str):
         self._t0 = time.perf_counter()
         self._counted = False  # per-request (connections are reused)
         self._body_consumed = False
+        self._shed = False
         url = urlparse(self.path)
         path = url.path.rstrip("/")
         query = parse_qs(url.query)
+        self._route_path = path or "/"
 
+        # observability plane: exempt from admission (scrapes must land
+        # during the exact overload they are meant to diagnose)
+        if method == "GET" and path == "/metrics":
+            if not getattr(self.server, "metrics_enabled", False):
+                return self._reply(404, {"error": "metrics endpoint disabled "
+                                                  "(sdad --metrics)"})
+            return self._reply(
+                200, raw=metrics.prometheus_text().encode("utf-8"),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+
+        # admission control: shed BEFORE auth/crypto/store work. A rejected
+        # request costs one header parse; Retry-After tells the retrying
+        # transport exactly when the token bucket refills.
+        admission = getattr(self.server, "admission", None)
+        if admission is not None and admission.enabled:
+            shed = admission.admit(self._agent_key())
+            if shed is not None:
+                log.debug("%s %s -> %d shed (%s, retry in %.3fs)",
+                          method, path, shed.status, shed.reason,
+                          shed.retry_after)
+                self._shed = True
+                return self._reply(
+                    shed.status, {"error": f"throttled: {shed.reason}"},
+                    retry_after=shed.retry_after,
+                )
+            try:
+                return self._dispatch(method, path, query)
+            finally:
+                admission.release()
+        return self._dispatch(method, path, query)
+
+    def _dispatch(self, method: str, path: str, query):
         def m(pattern):
             return re.fullmatch(pattern, path)
 
@@ -358,15 +461,48 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class SdaHttpServer:
-    """Threaded HTTP server wrapping an SdaServerService."""
+    """Threaded HTTP server wrapping an SdaServerService.
 
-    def __init__(self, service: SdaServerService, bind: str = "127.0.0.1:8888"):
+    ``max_inflight`` / ``rate_limit`` / ``rate_burst`` arm the admission
+    layer (both default off — zero overhead and bit-compatible behavior
+    with the pre-admission server); ``metrics_endpoint`` enables the
+    plaintext Prometheus exposition at ``GET /metrics`` (off by default:
+    it reveals traffic shape, opt in via ``sdad --metrics``).
+    """
+
+    def __init__(
+        self,
+        service: SdaServerService,
+        bind: str = "127.0.0.1:8888",
+        *,
+        max_inflight: Optional[int] = None,
+        rate_limit: Optional[float] = None,
+        rate_burst: float = 8.0,
+        metrics_endpoint: bool = False,
+    ):
         host, _, port = bind.partition(":")
         self.httpd = ThreadingHTTPServer((host, int(port or 8888)), _Handler)
         self.httpd.sda_service = service  # type: ignore[attr-defined]
         self.httpd.status_counts = {}  # type: ignore[attr-defined]
         self.httpd.stats_lock = threading.Lock()  # type: ignore[attr-defined]
+        self.admission = AdmissionControl(
+            max_inflight=max_inflight, rate=rate_limit, burst=rate_burst
+        )
+        self.httpd.admission = self.admission  # type: ignore[attr-defined]
+        self.httpd.metrics_enabled = metrics_endpoint  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+
+    def configure_admission(
+        self,
+        max_inflight: Optional[int] = None,
+        rate_limit: Optional[float] = None,
+        rate_burst: Optional[float] = None,
+    ) -> None:
+        """Retune (or disable, with all-``None``) admission at runtime —
+        the loadgen driver arms overload profiles only after round setup."""
+        self.admission.configure(
+            max_inflight=max_inflight, rate=rate_limit, burst=rate_burst
+        )
 
     @property
     def status_counts(self) -> dict:

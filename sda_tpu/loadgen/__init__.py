@@ -1,0 +1,13 @@
+"""Load & capacity plane: open/closed-loop workload generation against the
+real HTTP stack, with per-route latency histograms and capacity reports.
+
+The measurement counterpart to ``sda_tpu.chaos``: chaos proves the round
+survives faults, loadgen proves (and quantifies) how it survives traffic —
+sustained RPS, p50/p95/p99 tails per route, shed/retry behavior under the
+server's admission control. Entry points: ``sda-sim --load`` (CLI) and
+``run_load`` (tests, notebooks). ``docs/load.md`` has the tuning guide.
+"""
+
+from .driver import LoadProfile, latency_report_ms, run_load
+
+__all__ = ["LoadProfile", "latency_report_ms", "run_load"]

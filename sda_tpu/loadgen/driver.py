@@ -1,0 +1,357 @@
+"""The load drill: N simulated participants hammer the real HTTP stack.
+
+Where ``chaos/drill.py`` proves the system survives *faults*, this driver
+proves it survives *traffic* — and measures the shape of that survival.
+It stands up a real ``SdaHttpServer`` on a store backend, runs the full
+secure-aggregation round (committee election, participations, clerking,
+reveal), and drives the participant phase with one of two classic
+workload models:
+
+- **open-loop** (default): participant arrivals are a seeded Poisson
+  process at ``target_rps`` — arrivals don't wait for completions, so a
+  saturated server sees a growing backlog instead of the flattering
+  self-throttling a closed loop gives (the open- vs closed-loop pitfall
+  from the Tail-at-Scale literature). Scheduling lag is recorded in the
+  ``load.lag`` histogram so coordinated omission is visible.
+- **closed-loop**: exactly ``concurrency`` workers issue
+  request-after-request — the saturation probe.
+
+Every HTTP request lands in the server's per-route
+``http.latency.<route>`` histograms; the driver's own phases ride
+``load.phase.register`` / ``load.phase.participate``. The returned
+capacity report (BENCH-style JSON via ``sda-sim --load``) carries
+sustained RPS, p50/p95/p99 per route, shed/retry/error rates, and the
+end-to-end verdict: the revealed sum must still be bit-exact, and every
+*admitted* participation must be present — load shedding may slow the
+round, never corrupt it.
+
+Overload is a profile, not an accident: arm the server's admission layer
+(``rate_limit`` / ``max_inflight``) and the swarm gets 429+``Retry-After``
+sheds that the retrying transport converges through — zero 5xx, zero lost
+participations. ``chaos_rate`` arms the fault registry on top for the
+combined load+chaos drill.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .. import chaos
+from ..utils import metrics
+
+
+@dataclass
+class LoadProfile:
+    """Everything one load run needs; defaults match the acceptance drill
+    (200 participants, open-loop, memory store, admission off)."""
+
+    participants: int = 200
+    dim: int = 8
+    arrivals: str = "open"              # "open" (Poisson) | "closed"
+    target_rps: float = 100.0            # open-loop participant arrival rate
+    concurrency: int = 32                # worker pool (closed-loop: exact)
+    seed: int = 0
+    store: str = "memory"                # memory | sqlite | jsonfs
+    store_path: Optional[str] = None
+    # admission knobs, armed AFTER round setup (None = off)
+    max_inflight: Optional[int] = None
+    rate_limit: Optional[float] = None   # per-agent tokens/sec
+    rate_burst: float = 4.0
+    # combined load+chaos drill: fraction of requests to 500 (0 = off)
+    chaos_rate: float = 0.0
+    lease_seconds: float = 2.0
+    timeout_s: float = 300.0
+
+
+def _percentiles_ms(summary: dict) -> dict:
+    """One histogram summary, seconds -> milliseconds, rounded for JSON."""
+    return {
+        "count": int(summary["count"]),
+        "p50_ms": round(summary["p50"] * 1e3, 3),
+        "p95_ms": round(summary["p95"] * 1e3, 3),
+        "p99_ms": round(summary["p99"] * 1e3, 3),
+        "max_ms": round(summary["max"] * 1e3, 3),
+        "mean_ms": round(summary["sum"] / summary["count"] * 1e3, 3)
+        if summary["count"] else 0.0,
+    }
+
+
+def latency_report_ms(prefix: str = "http.latency.") -> dict:
+    """Per-route latency table (ms) from the live histogram registry —
+    shared by the load and chaos drill reports."""
+    return {
+        name[len(prefix):]: _percentiles_ms(summary)
+        for name, summary in metrics.histogram_report(prefix).items()
+    }
+
+
+def run_load(profile: LoadProfile) -> dict:
+    """Run one full aggregation round under generated load; return the
+    capacity report. Requires libsodium (real participant crypto)."""
+    import numpy as np
+
+    from ..client import SdaClient
+    from ..crypto import MemoryKeystore, sodium
+    from ..http import SdaHttpClient, SdaHttpServer
+    from ..protocol import (
+        Aggregation,
+        AggregationId,
+        FullMasking,
+        PackedShamirSharing,
+        SodiumEncryption,
+    )
+    from ..server import new_jsonfs_server, new_memory_server, new_sqlite_server
+
+    if not sodium.available():
+        raise RuntimeError("the load drill needs libsodium (real crypto round)")
+    if profile.arrivals not in ("open", "closed"):
+        raise ValueError(f"unknown arrivals model {profile.arrivals!r}")
+
+    # the golden 8-clerk packed-Shamir committee (same as the chaos drill):
+    # crypto real, parameters small — the object under test is the
+    # transport/store plane, not the field arithmetic
+    scheme = PackedShamirSharing(
+        secret_count=3, share_count=8, privacy_threshold=4,
+        prime_modulus=433, omega_secrets=354, omega_shares=150,
+    )
+
+    metrics.reset_all()
+    chaos.reset()
+
+    if profile.store == "memory":
+        service_impl = new_memory_server()
+    elif profile.store == "sqlite":
+        service_impl = new_sqlite_server(profile.store_path or ":memory:")
+    elif profile.store == "jsonfs":
+        if profile.store_path is None:
+            raise ValueError("store='jsonfs' needs store_path")
+        service_impl = new_jsonfs_server(profile.store_path)
+    else:
+        raise ValueError(f"unknown store {profile.store!r}")
+    service_impl.server.clerking_lease_seconds = profile.lease_seconds
+
+    http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
+    http_server.start_background()
+    failures: List[str] = []
+    failures_lock = threading.Lock()
+    try:
+        proxy = SdaHttpClient(
+            http_server.address,
+            token="load-drill-token",
+            # generous retry budget: under the overload profile EVERY
+            # participant is expected to be shed at least once and must
+            # converge through Retry-After hints within the deadline
+            max_retries=16, backoff_base=0.01, backoff_cap=0.25,
+            deadline=profile.timeout_s,
+        )
+
+        def new_client():
+            keystore = MemoryKeystore()
+            agent = SdaClient.new_agent(keystore)
+            return SdaClient(agent, keystore, proxy)
+
+        # -- setup (unthrottled: admission armed after) -------------------
+        recipient = new_client()
+        recipient.upload_agent()
+        recipient_key = recipient.new_encryption_key()
+        recipient.upload_encryption_key(recipient_key)
+
+        candidates = {recipient.agent.id: recipient}
+        for _ in range(scheme.share_count):
+            clerk = new_client()
+            clerk.upload_agent()
+            clerk.upload_encryption_key(clerk.new_encryption_key())
+            candidates[clerk.agent.id] = clerk
+
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="load-drill",
+            vector_dimension=profile.dim,
+            modulus=scheme.prime_modulus,
+            recipient=recipient.agent.id,
+            recipient_key=recipient_key,
+            masking_scheme=FullMasking(scheme.prime_modulus),
+            committee_sharing_scheme=scheme,
+            recipient_encryption_scheme=SodiumEncryption(),
+            committee_encryption_scheme=SodiumEncryption(),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+        committee = recipient.service.get_committee(recipient.agent, agg.id)
+        clerks = [candidates[cid] for cid, _ in committee.clerks_and_keys]
+
+        # -- arm admission + chaos, then open the floodgates --------------
+        http_server.configure_admission(
+            max_inflight=profile.max_inflight,
+            rate_limit=profile.rate_limit,
+            rate_burst=profile.rate_burst,
+        )
+        if profile.chaos_rate > 0.0:
+            chaos.configure("http.server.request", error=True,
+                            rate=profile.chaos_rate, seed=profile.seed)
+
+        rng = np.random.default_rng(profile.seed)
+        inputs = rng.integers(0, scheme.prime_modulus,
+                              size=(profile.participants, profile.dim),
+                              dtype=np.int64)
+
+        def participant_task(index: int, scheduled: float, t_open: float):
+            start = time.perf_counter()
+            if profile.arrivals == "open":
+                metrics.observe("load.lag", max(0.0, (start - t_open) - scheduled))
+            try:
+                t0 = time.perf_counter()
+                participant = new_client()
+                participant.upload_agent()
+                metrics.observe("load.phase.register",
+                                time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                participant.participate(
+                    [int(x) for x in inputs[index]], agg.id
+                )
+                metrics.observe("load.phase.participate",
+                                time.perf_counter() - t1)
+                return True
+            except Exception as e:  # tallied, not fatal: the report decides
+                with failures_lock:
+                    failures.append(f"participant {index}: "
+                                    f"{type(e).__name__}: {e}")
+                return False
+
+        arrival_rng = random.Random(profile.seed)
+        setup_requests = sum(http_server.status_counts.values())
+        t_load0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, profile.concurrency)
+        ) as pool:
+            futures = []
+            if profile.arrivals == "open":
+                # seeded Poisson arrivals: submit at the scheduled instant
+                # whether or not earlier work finished (open loop); the
+                # bounded pool then queues — the backlog shows up in
+                # load.lag, not in a silently stretched schedule
+                t_arrival = 0.0
+                for i in range(profile.participants):
+                    t_arrival += arrival_rng.expovariate(profile.target_rps)
+                    delay = t_arrival - (time.perf_counter() - t_load0)
+                    if delay > 0:
+                        time.sleep(delay)
+                    futures.append(
+                        pool.submit(participant_task, i, t_arrival, t_load0)
+                    )
+            else:
+                for i in range(profile.participants):
+                    futures.append(pool.submit(participant_task, i, 0.0, t_load0))
+            completed = sum(bool(f.result()) for f in futures)
+        load_elapsed = time.perf_counter() - t_load0
+        # the headline RPS covers ONLY the participant window: snapshot
+        # before the close phase adds clerk polling traffic
+        load_requests = sum(http_server.status_counts.values()) - setup_requests
+
+        # -- close the round: snapshot, clerking, reveal ------------------
+        recipient.end_aggregation(agg.id)
+        deadline = time.monotonic() + profile.timeout_s
+        ready = False
+        status = None
+        while time.monotonic() < deadline:
+            for clerk in clerks:
+                clerk.run_chores(-1)
+            status = recipient.service.get_aggregation_status(
+                recipient.agent, agg.id
+            )
+            if (
+                status is not None
+                and status.snapshots
+                and status.snapshots[0].number_of_clerking_results
+                >= scheme.share_count
+            ):
+                ready = True
+                break
+            time.sleep(0.05)
+
+        exact = False
+        admitted_participations = None
+        if status is not None:
+            admitted_participations = status.number_of_participations
+        # zero lost participations among admitted requests: every
+        # participant whose upload was ACKed must be in the round, and
+        # with all of them in, the revealed sum must be bit-exact (a
+        # failed participant MAY still have landed server-side — lost
+        # final ack — so exactness is only decidable at zero failures)
+        if ready and completed == profile.participants:
+            output = recipient.reveal_aggregation(agg.id)
+            expected = inputs.sum(axis=0) % scheme.prime_modulus
+            exact = bool((output.positive().values == expected).all())
+    finally:
+        failpoint_report = chaos.report()
+        chaos.reset()
+        total_elapsed = time.perf_counter() - t_load0 \
+            if "t_load0" in locals() else 0.0
+        status_counts = http_server.status_counts
+        http_server.shutdown()
+
+    counters = metrics.counter_report()
+    lag_summary = metrics.histogram_report("load.lag").get("load.lag")
+    requests_total = sum(status_counts.values())
+    shed = sum(v for k, v in status_counts.items() if k == 429)
+    errors_5xx = sum(v for k, v in status_counts.items() if k >= 500)
+    report = {
+        "mode": (f"loadgen {profile.arrivals}-loop "
+                 f"({profile.store} store"
+                 + (", overload profile" if profile.rate_limit is not None
+                    or profile.max_inflight is not None else "")
+                 + (f", chaos rate {profile.chaos_rate}"
+                    if profile.chaos_rate else "")
+                 + ")"),
+        "participants": profile.participants,
+        "dim": profile.dim,
+        "clerks": scheme.share_count,
+        "arrivals": profile.arrivals,
+        "target_rps": profile.target_rps if profile.arrivals == "open" else None,
+        "concurrency": profile.concurrency,
+        "seed": profile.seed,
+        "admission": {
+            "max_inflight": profile.max_inflight,
+            "rate_limit": profile.rate_limit,
+            "rate_burst": profile.rate_burst,
+        },
+        "completed": completed,
+        "client_failures": len(failures),
+        "failure_samples": failures[:5] or None,
+        "admitted_participations": admitted_participations,
+        "ready": ready,
+        "exact": exact,
+        "load_seconds": round(load_elapsed, 4),
+        "round_seconds": round(total_elapsed, 4),
+        "sustained_rps": round(load_requests / load_elapsed, 1)
+        if load_elapsed else 0.0,
+        "load_requests": load_requests,
+        "requests": requests_total,
+        "shed_429": shed,
+        "errors_5xx": errors_5xx,
+        "status_counts": {str(k): v for k, v in sorted(status_counts.items())},
+        "throttled": metrics.counter_report("http.throttled.") or None,
+        "retries": metrics.counter_report("http.retry.") or None,
+        "inflight_peak": metrics.gauge_report("http.inflight.peak").get(
+            "http.inflight.peak"
+        ),
+        "latency_ms": latency_report_ms(),
+        "phases_ms": {
+            name[len("load.phase."):]: _percentiles_ms(summary)
+            for name, summary in
+            metrics.histogram_report("load.phase.").items()
+        },
+        "lag_ms": _percentiles_ms(lag_summary) if lag_summary else None,
+        "failpoints": failpoint_report or None,
+        "counters": {
+            k: v for k, v in counters.items()
+            if k.startswith(("chaos.", "server.job.", "server.snapshot.",
+                             "server.participation."))
+        } or None,
+    }
+    return report

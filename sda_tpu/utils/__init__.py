@@ -7,7 +7,19 @@ from .timing import (
     reset_phase_report,
     timed_phase,
 )
-from .metrics import count, counter_report, reset_counters
+from .metrics import (
+    count,
+    counter_report,
+    gauge_max,
+    gauge_report,
+    gauge_set,
+    histogram_report,
+    observe,
+    prometheus_text,
+    reset_counters,
+    reset_gauges,
+    reset_histograms,
+)
 from .logsetup import configure_logging
 
 __all__ = [
@@ -15,9 +27,17 @@ __all__ = [
     "configure_logging",
     "count",
     "counter_report",
+    "gauge_max",
+    "gauge_report",
+    "gauge_set",
+    "histogram_report",
+    "observe",
     "phase_report",
     "profile_trace",
+    "prometheus_text",
     "reset_counters",
+    "reset_gauges",
+    "reset_histograms",
     "reset_phase_report",
     "timed_phase",
 ]
