@@ -27,6 +27,38 @@ print(f"loadgen smoke OK: {report['load_requests']} load-phase requests, "
       f"{report['sustained_rps']} rps sustained")
 PY
 
+echo "== trace smoke (fixed seed: Chrome-trace export, one connected round trace, bit-exact)"
+TRACE_OUT=$(mktemp /tmp/sda-trace-XXXX.json)
+TRACE_REPORT=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 12 --dim 4 \
+  --load-arrivals closed --load-concurrency 4 --load-seed 20260803 \
+  --load-store memory --trace-out "$TRACE_OUT")
+TRACE_REPORT="$TRACE_REPORT" TRACE_OUT="$TRACE_OUT" python - <<'PY'
+import json, os
+report = json.loads(os.environ["TRACE_REPORT"].strip().splitlines()[-1])
+# the round result must stay bit-exact with tracing enabled
+assert report["ready"] and report["exact"], report
+trace = json.load(open(os.environ["TRACE_OUT"]))  # must parse as JSON
+spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+by_id = {e["args"]["span_id"]: e for e in spans}
+traces = {}
+for e in spans:
+    traces.setdefault(e["args"]["trace_id"], []).append(e)
+round_traces = 0
+for members in traces.values():
+    roles = {e["name"].split(" ")[0].split(".")[0] for e in members}
+    # cross-process-connected: a server span whose parent is a client
+    # attempt span proves the trace crossed the HTTP hop
+    crossed = any(
+        e["name"].startswith("http.server")
+        and by_id.get(e["args"].get("parent_id", ""), {}).get("name") == "http.attempt"
+        for e in members)
+    if {"participant", "server", "clerk", "recipient"} <= roles and crossed:
+        round_traces += 1
+assert round_traces >= 1, f"no connected round trace among {len(traces)}"
+print(f"trace smoke OK: {len(spans)} spans, {round_traces} connected round trace(s)")
+PY
+rm -f "$TRACE_OUT"
+
 echo "== CLI walkthrough (real sdad + sda over HTTP)"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu bash docs/walkthrough.sh | tail -1 | {
   read -r reveal

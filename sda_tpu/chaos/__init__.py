@@ -170,6 +170,11 @@ class FailpointRegistry:
                 return None
             action = point.action()
         metrics.count(f"chaos.{name}")
+        # stamp the injection on the active span (no-op without one): a
+        # trace timeline then shows WHICH injected fault hit WHICH round
+        from .. import obs
+
+        obs.add_event(f"chaos.{name}", kind=action.kind)
         return action
 
     def fail(self, name: str) -> Optional[Action]:

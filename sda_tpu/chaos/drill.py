@@ -17,7 +17,10 @@ registry (``sda_tpu.chaos``):
 
 The round must still reveal the bit-exact sum; the returned report carries
 every ``chaos.*`` / ``http.retry.*`` / ``server.job.*`` counter so the
-injection schedule is auditable.
+injection schedule is auditable — plus the round's trace timeline
+(``sda_tpu.obs``): the whole drill runs under one ``round`` span, every
+failpoint trigger lands as a span event, and the report's critical path
+shows which injected fault lengthened the round.
 """
 
 from __future__ import annotations
@@ -25,7 +28,7 @@ from __future__ import annotations
 import time
 from typing import List
 
-from .. import chaos
+from .. import chaos, obs
 from ..utils import metrics
 
 
@@ -71,7 +74,7 @@ def run_chaos_drill(
         prime_modulus=433, omega_secrets=354, omega_shares=150,
     )
 
-    metrics.reset_all()
+    obs.reset_all()
     chaos.reset()
 
     if store == "memory":
@@ -89,97 +92,105 @@ def run_chaos_drill(
     http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
     http_server.start_background()
     try:
-        def new_client():
-            keystore = MemoryKeystore()
-            proxy = SdaHttpClient(
-                http_server.address,
-                token="chaos-drill-token",
-                # fast, deterministic-budget retries: the drill injects a
-                # bounded failure schedule, so a handful of quick attempts
-                # always clears it
-                max_retries=8, backoff_base=0.01, backoff_cap=0.1,
+        # ONE round span ties every role together: participant uploads,
+        # server handling (joined via traceparent), clerk jobs (joined via
+        # the enqueue-time job link), and the recipient reveal
+        with obs.span("round", attributes={"profile": "chaos",
+                                           "participants": participants,
+                                           "seed": seed}):
+            def new_client():
+                keystore = MemoryKeystore()
+                proxy = SdaHttpClient(
+                    http_server.address,
+                    token="chaos-drill-token",
+                    # fast, deterministic-budget retries: the drill injects a
+                    # bounded failure schedule, so a handful of quick attempts
+                    # always clears it
+                    max_retries=8, backoff_base=0.01, backoff_cap=0.1,
+                )
+                agent = SdaClient.new_agent(keystore)
+                return SdaClient(agent, keystore, proxy)
+
+            # -- clean setup (no injection yet: the drill targets the round)
+            recipient = new_client()
+            recipient.upload_agent()
+            recipient_key = recipient.new_encryption_key()
+            recipient.upload_encryption_key(recipient_key)
+
+            # the recipient owns a key too, so it is a committee candidate —
+            # track every key-holding client by id and let the election decide
+            candidates = {recipient.agent.id: recipient}
+            for _ in range(scheme.share_count):
+                clerk = new_client()
+                clerk.upload_agent()
+                clerk.upload_encryption_key(clerk.new_encryption_key())
+                candidates[clerk.agent.id] = clerk
+
+            agg = Aggregation(
+                id=AggregationId.random(),
+                title="chaos-drill",
+                vector_dimension=dim,
+                modulus=scheme.prime_modulus,
+                recipient=recipient.agent.id,
+                recipient_key=recipient_key,
+                masking_scheme=FullMasking(scheme.prime_modulus),
+                committee_sharing_scheme=scheme,
+                recipient_encryption_scheme=SodiumEncryption(),
+                committee_encryption_scheme=SodiumEncryption(),
             )
-            agent = SdaClient.new_agent(keystore)
-            return SdaClient(agent, keystore, proxy)
+            recipient.upload_aggregation(agg)
+            recipient.begin_aggregation(agg.id)
+            committee = recipient.service.get_committee(recipient.agent, agg.id)
+            clerks: List[SdaClient] = [
+                candidates[cid] for cid, _ in committee.clerks_and_keys
+            ]
 
-        # -- clean setup (no injection yet: the drill targets the round) --
-        recipient = new_client()
-        recipient.upload_agent()
-        recipient_key = recipient.new_encryption_key()
-        recipient.upload_encryption_key(recipient_key)
+            # -- arm the failpoints, then run the whole round under fire --
+            chaos.configure("http.server.request", error=True, rate=rate,
+                            seed=seed)
+            chaos.configure("http.server.response", drop=True, times=1,
+                            seed=seed)
+            chaos.configure("store.create_participation", error=True, times=1,
+                            seed=seed)
+            chaos.configure("clerk.abandon_job", drop=True, times=1, seed=seed)
+            if extra_spec:
+                chaos.configure_from_spec(extra_spec, seed=seed)
 
-        # the recipient owns a key too, so it is a committee candidate —
-        # track every key-holding client by id and let the election decide
-        candidates = {recipient.agent.id: recipient}
-        for _ in range(scheme.share_count):
-            clerk = new_client()
-            clerk.upload_agent()
-            clerk.upload_encryption_key(clerk.new_encryption_key())
-            candidates[clerk.agent.id] = clerk
+            rng = np.random.default_rng(seed)
+            inputs = rng.integers(0, scheme.prime_modulus,
+                                  size=(participants, dim), dtype=np.int64)
+            for row in inputs:
+                participant = new_client()
+                participant.upload_agent()
+                participant.participate([int(x) for x in row], agg.id)
+            recipient.end_aggregation(agg.id)  # snapshot + job fan-out
 
-        agg = Aggregation(
-            id=AggregationId.random(),
-            title="chaos-drill",
-            vector_dimension=dim,
-            modulus=scheme.prime_modulus,
-            recipient=recipient.agent.id,
-            recipient_key=recipient_key,
-            masking_scheme=FullMasking(scheme.prime_modulus),
-            committee_sharing_scheme=scheme,
-            recipient_encryption_scheme=SodiumEncryption(),
-            committee_encryption_scheme=SodiumEncryption(),
-        )
-        recipient.upload_aggregation(agg)
-        recipient.begin_aggregation(agg.id)
-        committee = recipient.service.get_committee(recipient.agent, agg.id)
-        clerks: List[SdaClient] = [
-            candidates[cid] for cid, _ in committee.clerks_and_keys
-        ]
+            # clerks keep polling until EVERY job has a result — waiting for
+            # the full committee (not just reconstruction_threshold) is what
+            # forces the abandoned job through the lease-expiry reissue path
+            deadline = time.monotonic() + timeout_s
+            ready = False
+            while time.monotonic() < deadline:
+                for clerk in clerks:
+                    clerk.run_chores(-1)
+                status = recipient.service.get_aggregation_status(
+                    recipient.agent, agg.id
+                )
+                if (
+                    status is not None
+                    and status.snapshots
+                    and status.snapshots[0].number_of_clerking_results
+                    >= scheme.share_count
+                ):
+                    ready = True
+                    break
+                time.sleep(min(0.1, lease_seconds / 4))
 
-        # -- arm the failpoints, then run the whole round under fire ------
-        chaos.configure("http.server.request", error=True, rate=rate, seed=seed)
-        chaos.configure("http.server.response", drop=True, times=1, seed=seed)
-        chaos.configure("store.create_participation", error=True, times=1,
-                        seed=seed)
-        chaos.configure("clerk.abandon_job", drop=True, times=1, seed=seed)
-        if extra_spec:
-            chaos.configure_from_spec(extra_spec, seed=seed)
-
-        rng = np.random.default_rng(seed)
-        inputs = rng.integers(0, scheme.prime_modulus,
-                              size=(participants, dim), dtype=np.int64)
-        for row in inputs:
-            participant = new_client()
-            participant.upload_agent()
-            participant.participate([int(x) for x in row], agg.id)
-        recipient.end_aggregation(agg.id)  # snapshot + job fan-out
-
-        # clerks keep polling until EVERY job has a result — waiting for
-        # the full committee (not just reconstruction_threshold) is what
-        # forces the abandoned job through the lease-expiry reissue path
-        deadline = time.monotonic() + timeout_s
-        ready = False
-        while time.monotonic() < deadline:
-            for clerk in clerks:
-                clerk.run_chores(-1)
-            status = recipient.service.get_aggregation_status(
-                recipient.agent, agg.id
-            )
-            if (
-                status is not None
-                and status.snapshots
-                and status.snapshots[0].number_of_clerking_results
-                >= scheme.share_count
-            ):
-                ready = True
-                break
-            time.sleep(min(0.1, lease_seconds / 4))
-
-        exact = False
-        if ready:
-            output = recipient.reveal_aggregation(agg.id)
-            expected = inputs.sum(axis=0) % scheme.prime_modulus
-            exact = bool((output.positive().values == expected).all())
+            exact = False
+            if ready:
+                output = recipient.reveal_aggregation(agg.id)
+                expected = inputs.sum(axis=0) % scheme.prime_modulus
+                exact = bool((output.positive().values == expected).all())
     finally:
         # snapshot the schedule, then disarm BEFORE shutdown so teardown
         # requests aren't chaos'd
@@ -200,6 +211,10 @@ def run_chaos_drill(
     )
     dropped = counters.get("chaos.http.server.response", 0)
     requests_total = counters.get("http.request", 0) + dropped
+    # the round timeline: slowest-first, so [0] is the drill's round trace
+    # (every span shares its trace id); chaos_events names each injection
+    # and the span it hit, critical_path the chain that set round duration
+    timelines = obs.round_timelines()
     report = {
         "mode": f"chaos drill over HTTP ({store} store)",
         "participants": participants,
@@ -222,5 +237,6 @@ def run_chaos_drill(
         # per-route server latency under fire: the tail the retry budget
         # has to ride out (loadgen measures the same table under load)
         "latency_ms": _latency_report_ms(),
+        "trace": timelines[0] if timelines else None,
     }
     return report

@@ -33,6 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="serve Prometheus text exposition (counters + "
                              "latency histogram buckets) at GET /metrics "
                              "(off by default)")
+    parser.add_argument("--trace", action="store_true",
+                        help="log one INFO line per finished request span "
+                             "(trace id, route, status, X-Request-Id); "
+                             "combine with SDA_LOG_FORMAT=json for "
+                             "trace-correlated structured logs")
     parser.add_argument("--max-inflight", type=int, metavar="N", default=None,
                         help="admission control: shed requests with 503 + "
                              "Retry-After beyond N concurrently in flight "
@@ -84,7 +89,16 @@ def main(argv=None) -> int:
         rate_limit=args.rate_limit,
         rate_burst=args.rate_burst,
         metrics_endpoint=args.metrics,
+        trace_log=args.trace,
     )
+    if args.trace:
+        # the span lines ride logging.INFO on their own child logger; make
+        # exactly them visible even without -v (the access log stays muted)
+        import logging
+
+        from ..http.server import trace_log
+
+        trace_log.setLevel(logging.INFO)
     print(f"sdad listening on {server.address}", flush=True)
     try:
         server.serve_forever()

@@ -110,6 +110,11 @@ def build_parser() -> argparse.ArgumentParser:
                         default=None,
                         help="simulate losing these clerk indices: the "
                              "finale reveals from the surviving quorum only")
+    parser.add_argument("--trace-out", metavar="PATH", default=None,
+                        help="export the run's span timeline as Chrome-trace "
+                             "JSON (load in chrome://tracing / Perfetto; "
+                             "works with the drill profiles and the mesh "
+                             "modes; see docs/observability.md)")
     parser.add_argument("--multihost", type=int, metavar="N", default=0,
                         help="spawn N OS processes (gRPC collectives); each "
                              "owns 1/N of the participants and devices")
@@ -119,6 +124,28 @@ def build_parser() -> argparse.ArgumentParser:
                         help="recompute the plain sum on host and compare")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     return parser
+
+
+def _export_trace(args, report=None) -> None:
+    """--trace-out: write the recorded span timeline as Chrome-trace JSON
+    (and note the path in the report when one is being assembled)."""
+    if not args.trace_out:
+        return
+    import os
+
+    from .. import obs
+
+    # multihost workers all inherit the same argv: give each rank its own
+    # file instead of racing N writers over one path (rank 0 — whose JSON
+    # line is the forwarded result — keeps the exact requested path)
+    path = args.trace_out
+    rank = os.environ.get("SDA_SIM_PID")
+    if rank and rank != "0":
+        path = f"{path}.rank{rank}"
+    trace = obs.export_chrome_trace(path)
+    if report is not None:
+        report["trace_out"] = path
+        report["trace_events"] = len(trace["traceEvents"])
 
 
 def _run_multihost(args, argv=None) -> int:
@@ -231,6 +258,7 @@ def _run_load(args) -> int:
             rate_burst=4.0 if burst is None else burst,
             chaos_rate=chaos_rate,
         ))
+    _export_trace(args, report)
     print(json.dumps(report))
     ok = report["ready"] and report["exact"] and not report["client_failures"]
     if chaos_rate == 0.0:
@@ -268,19 +296,15 @@ def _run_chaos(args) -> int:
             store_path=None if args.chaos_store == "memory" else f"{tmp}/store",
             extra_spec=args.chaos_spec,
         )
+    _export_trace(args, report)
     print(json.dumps(report))
     return 0 if report["exact"] else 1
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    from ..utils import (
-        configure_logging,
-        counter_report,
-        phase_report,
-        reset_counters,
-        reset_phase_report,
-    )
+    from .. import obs
+    from ..utils import configure_logging, counter_report, phase_report
 
     configure_logging(args.verbose)
 
@@ -389,8 +413,7 @@ def main(argv=None) -> int:
     if coord is None:
         inputs = rng.integers(0, 1 << 20, size=(args.participants, dim),
                               dtype=np.int64)
-    reset_phase_report()
-    reset_counters()
+    obs.reset_all()
     key = jax.random.PRNGKey(0)
     if coord is not None:
         from ..mesh import StreamedPod, make_multislice_mesh, multihost as mh
@@ -484,6 +507,7 @@ def main(argv=None) -> int:
     counters = counter_report()
     if counters:
         result["counters"] = counters
+    _export_trace(args, result)
     print(json.dumps(result))
     return 0
 

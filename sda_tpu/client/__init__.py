@@ -13,6 +13,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import obs
 from ..crypto import CryptoModule, Keystore, signature_is_valid
 from ..utils import timed_phase
 from ..protocol import (
@@ -124,7 +125,9 @@ class SdaClient:
 
     def participate(self, input: Sequence[int], aggregation: AggregationId) -> None:
         """new_participation + upload in one go (participate.rs:31-35)."""
-        self.upload_participation(self.new_participation(input, aggregation))
+        with obs.span("participant.participate",
+                      attributes={"aggregation": str(aggregation)}):
+            self.upload_participation(self.new_participation(input, aggregation))
 
     def new_participation(
         self, input: Sequence[int], aggregation_id: AggregationId
@@ -224,15 +227,27 @@ class SdaClient:
         job = self.service.get_clerking_job(self.agent, self.agent.id)
         if job is None:
             return False
-        # failpoint: the clerk dies AFTER pulling work — the job is pulled
-        # (and, with leasing, invisible to its siblings) but no result ever
-        # lands; lease expiry is what brings it back
-        from .. import chaos
+        # parent the processing to the trace that ENQUEUED the job (the
+        # round's snapshot), recorded server-side at enqueue time and
+        # propagated here via the X-Trace-Context poll header or the
+        # in-process link registry. A lease-reissued job carries the same
+        # deterministic id, so reissued work re-joins the original trace.
+        link = obs.job_link(str(job.id))
+        with obs.span(
+            "clerk.job", parent=link,
+            attributes={"job": str(job.id),
+                        "aggregation": str(job.aggregation)},
+        ) as job_span:
+            # failpoint: the clerk dies AFTER pulling work — the job is
+            # pulled (and, with leasing, invisible to its siblings) but no
+            # result ever lands; lease expiry is what brings it back
+            from .. import chaos
 
-        if chaos.evaluate("clerk.abandon_job", kinds=("drop",)) is not None:
-            return False
-        result = self.process_clerking_job(job)
-        self.service.create_clerking_result(self.agent, result)
+            if chaos.evaluate("clerk.abandon_job", kinds=("drop",)) is not None:
+                job_span.set_attribute("abandoned", True)
+                return False
+            result = self.process_clerking_job(job)
+            self.service.create_clerking_result(self.agent, result)
         return True
 
     def run_chores(self, max_iterations: int = -1) -> None:
@@ -373,14 +388,16 @@ class SdaClient:
 
     def end_aggregation(self, aggregation_id: AggregationId) -> None:
         """Close the round by creating a snapshot (receive.rs:64-78)."""
-        status = self.service.get_aggregation_status(self.agent, aggregation_id)
-        if status is None:
-            raise NotFound("unknown aggregation")
-        if len(status.snapshots) >= 1:
-            return
-        self.service.create_snapshot(
-            self.agent, Snapshot(id=SnapshotId.random(), aggregation=aggregation_id)
-        )
+        with obs.span("recipient.snapshot",
+                      attributes={"aggregation": str(aggregation_id)}):
+            status = self.service.get_aggregation_status(self.agent, aggregation_id)
+            if status is None:
+                raise NotFound("unknown aggregation")
+            if len(status.snapshots) >= 1:
+                return
+            self.service.create_snapshot(
+                self.agent, Snapshot(id=SnapshotId.random(), aggregation=aggregation_id)
+            )
 
     def snapshot_aggregation(self, aggregation_id: AggregationId) -> SnapshotId:
         """Freeze the current participation set as a NEW snapshot even if
@@ -388,7 +405,10 @@ class SdaClient:
         aggregation proceed through clerking independently (SURVEY §2.4;
         the reference server supports this, its client never drives it)."""
         snapshot = Snapshot(id=SnapshotId.random(), aggregation=aggregation_id)
-        self.service.create_snapshot(self.agent, snapshot)
+        with obs.span("recipient.snapshot",
+                      attributes={"aggregation": str(aggregation_id),
+                                  "snapshot": str(snapshot.id)}):
+            self.service.create_snapshot(self.agent, snapshot)
         return snapshot.id
 
     def reveal_aggregation(
@@ -397,6 +417,13 @@ class SdaClient:
         """Decrypt clerk results, reconstruct, combine+subtract masks
         (receive.rs:80-157). ``snapshot_id`` selects a specific pipelined
         round; default is the first result-ready snapshot (receive.rs:91-94)."""
+        with obs.span("recipient.reveal",
+                      attributes={"aggregation": str(aggregation_id)}):
+            return self._reveal_aggregation(aggregation_id, snapshot_id)
+
+    def _reveal_aggregation(
+        self, aggregation_id: AggregationId, snapshot_id: Optional[SnapshotId]
+    ) -> RecipientOutput:
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
             raise NotFound(f"unknown aggregation {aggregation_id}")

@@ -183,5 +183,10 @@ def new_participation_embedded(
 
 def participate_embedded(client, input: Sequence[int], aggregation_id) -> None:
     """Build natively + upload (the embedded ``participate``)."""
-    client.upload_participation(
-        new_participation_embedded(client, input, aggregation_id))
+    from .. import obs
+
+    with obs.span("participant.participate",
+                  attributes={"aggregation": str(aggregation_id),
+                              "embedded": True}):
+        client.upload_participation(
+            new_participation_embedded(client, input, aggregation_id))

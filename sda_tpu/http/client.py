@@ -21,6 +21,7 @@ from typing import List, Optional
 
 import requests
 
+from .. import obs
 from ..utils import metrics
 from ..protocol import (
     Agent,
@@ -54,6 +55,9 @@ RETRYABLE_STATUSES = frozenset({500, 502, 503, 504})
 #: request BEFORE doing any work, so retrying is always safe regardless of
 #: idempotence; the Retry-After hint says when.
 THROTTLED_STATUS = 429
+
+#: Resource ids inside request paths, collapsed to ``{id}`` in span names.
+_PATH_ID_RE = _re.compile(r"[0-9a-fA-F-]{36}")
 
 
 def _retry_after_seconds(response) -> Optional[float]:
@@ -235,66 +239,101 @@ class SdaHttpClient(SdaService):
         responses, and 429 admission sheds are transient (a server
         ``Retry-After`` hint overrides the jittered backoff, still capped
         at the deadline); everything else returns immediately for
-        ``_check`` to interpret."""
+        ``_check`` to interpret.
+
+        Tracing: the whole operation is one client span; every attempt is
+        a child span tagged with its attempt number, status/error cause,
+        and any ``Retry-After`` hint, and the attempt span's context rides
+        the W3C ``traceparent`` header so server-side handling joins this
+        trace."""
         url = self.base_url + path
         give_up_at = _time.monotonic() + self.deadline
         attempt = 0
-        while True:
-            cause, error, retry_after = None, None, None
-            # the deadline is a wall-clock budget: each attempt's socket
-            # timeout is clamped to what remains (floored so the first
-            # attempt always gets a chance even under a tiny deadline)
-            remaining = give_up_at - _time.monotonic()
-            try:
-                response = self.session.request(
-                    method, url, params=params, json=json, auth=auth,
-                    timeout=min(self.timeout, max(0.05, remaining)),
+        # span NAMES collapse resource ids (bounded cardinality, mirrors the
+        # server's route_label); the raw path rides the http.target attribute
+        with obs.span(
+            f"http.client {method} {_PATH_ID_RE.sub('{id}', path)}",
+            kind="client",
+            attributes={"http.method": method, "http.target": path},
+        ) as op_span:
+            while True:
+                cause, error, retry_after = None, None, None
+                # the deadline is a wall-clock budget: each attempt's socket
+                # timeout is clamped to what remains (floored so the first
+                # attempt always gets a chance even under a tiny deadline)
+                remaining = give_up_at - _time.monotonic()
+                with obs.span(
+                    "http.attempt", kind="client",
+                    attributes={"attempt": attempt},
+                ) as att_span:
+                    headers = {
+                        obs.TRACEPARENT_HEADER:
+                            obs.format_traceparent(att_span.context)
+                    }
+                    try:
+                        response = self.session.request(
+                            method, url, params=params, json=json, auth=auth,
+                            headers=headers,
+                            timeout=min(self.timeout, max(0.05, remaining)),
+                        )
+                    except requests.Timeout as e:
+                        cause, error = "timeout", e
+                    except requests.ConnectionError as e:
+                        cause, error = "connection", e
+                    else:
+                        att_span.set_attribute(
+                            "http.status", response.status_code)
+                        request_id = response.headers.get(
+                            obs.REQUEST_ID_HEADER)
+                        if request_id:
+                            att_span.set_attribute("request_id", request_id)
+                        if response.status_code == THROTTLED_STATUS:
+                            # admission shed: nothing executed server-side
+                            cause = "status_429"
+                            retry_after = _retry_after_seconds(response)
+                        elif response.status_code in RETRYABLE_STATUSES:
+                            cause = "status_5xx"
+                            retry_after = _retry_after_seconds(response)
+                        else:
+                            if attempt:
+                                metrics.count("http.retry.recovered")
+                                op_span.set_attribute("retries", attempt)
+                            return response
+                    if error is not None:
+                        att_span.set_attribute("error", cause)
+                    if retry_after is not None:
+                        att_span.set_attribute("retry_after_s", retry_after)
+                attempt += 1
+                if attempt > self.max_retries or _time.monotonic() >= give_up_at:
+                    metrics.count("http.retry.exhausted")
+                    op_span.set_attribute("retries", attempt)
+                    op_span.set_attribute("exhausted", True)
+                    if error is not None:
+                        raise error
+                    return response  # terminal 5xx: let _check raise ServerError
+                metrics.count("http.retry.attempt")
+                metrics.count(f"http.retry.{cause}")
+                jitter = _random.uniform(
+                    0.0,
+                    min(self.backoff_cap, self.backoff_base * (2 ** (attempt - 1))),
                 )
-            except requests.Timeout as e:
-                cause, error = "timeout", e
-            except requests.ConnectionError as e:
-                cause, error = "connection", e
-            else:
-                if response.status_code == THROTTLED_STATUS:
-                    # admission shed: nothing executed server-side
-                    cause = "status_429"
-                    retry_after = _retry_after_seconds(response)
-                elif response.status_code in RETRYABLE_STATUSES:
-                    cause = "status_5xx"
-                    retry_after = _retry_after_seconds(response)
+                if retry_after is not None:
+                    # the server told us when to come back: honor the hint,
+                    # PLUS the growing jitter — early retries follow the hint
+                    # closely (fast token-bucket convergence), persistent
+                    # shedding still decays into exponential backoff instead
+                    # of a cohort hammering at a constant hinted rate
+                    metrics.count("http.retry.after_hint")
+                    sleep = retry_after + jitter
                 else:
-                    if attempt:
-                        metrics.count("http.retry.recovered")
-                    return response
-            attempt += 1
-            if attempt > self.max_retries or _time.monotonic() >= give_up_at:
-                metrics.count("http.retry.exhausted")
-                if error is not None:
-                    raise error
-                return response  # terminal 5xx: let _check raise ServerError
-            metrics.count("http.retry.attempt")
-            metrics.count(f"http.retry.{cause}")
-            jitter = _random.uniform(
-                0.0,
-                min(self.backoff_cap, self.backoff_base * (2 ** (attempt - 1))),
-            )
-            if retry_after is not None:
-                # the server told us when to come back: honor the hint,
-                # PLUS the growing jitter — early retries follow the hint
-                # closely (fast token-bucket convergence), persistent
-                # shedding still decays into exponential backoff instead
-                # of a cohort hammering at a constant hinted rate
-                metrics.count("http.retry.after_hint")
-                sleep = retry_after + jitter
-            else:
-                sleep = jitter
-            sleep = min(sleep, max(0.0, give_up_at - _time.monotonic()))
-            log.debug(
-                "%s %s transient failure (%s), retry %d/%d in %.3fs",
-                method, path, cause, attempt, self.max_retries, sleep,
-            )
-            if sleep:
-                _time.sleep(sleep)
+                    sleep = jitter
+                sleep = min(sleep, max(0.0, give_up_at - _time.monotonic()))
+                log.debug(
+                    "%s %s transient failure (%s), retry %d/%d in %.3fs",
+                    method, path, cause, attempt, self.max_retries, sleep,
+                )
+                if sleep:
+                    _time.sleep(sleep)
 
     def _get(self, caller: Agent, path: str, params=None):
         return self._check(
@@ -412,9 +451,18 @@ class SdaHttpClient(SdaService):
         self._post(caller, "/v1/aggregations/participations", participation.to_obj())
 
     def get_clerking_job(self, caller, clerk):
-        return self._option(
-            self._get(caller, "/v1/aggregations/any/jobs"), ClerkingJob.from_obj
-        )
+        response = self._get(caller, "/v1/aggregations/any/jobs")
+        if response is None:
+            return None
+        job = ClerkingJob.from_obj(response.json())
+        # the server hands back the trace context the job was enqueued
+        # under (X-Trace-Context); mirror it locally so processing — even
+        # of a lease-REISSUED job — parents to the original round trace
+        ctx = obs.parse_traceparent(
+            response.headers.get(obs.TRACE_CONTEXT_HEADER))
+        if ctx is not None:
+            obs.link_job(str(job.id), ctx)
+        return job
 
     def create_clerking_result(self, caller, result):
         self._post(

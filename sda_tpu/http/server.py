@@ -66,10 +66,13 @@ from ..protocol import (
 )
 from ..server import SdaServerService, auth_token
 from ..utils import metrics
-from .. import chaos
+from .. import chaos, obs
 from .admission import AdmissionControl
 
 log = logging.getLogger(__name__)
+#: Dedicated child logger for the per-span trace lines, so ``sdad --trace``
+#: can unmute EXACTLY them without also unmuting the access log.
+trace_log = logging.getLogger(__name__ + ".trace")
 
 _ID = r"[0-9a-fA-F-]{36}"
 
@@ -99,6 +102,9 @@ _ROUTE_TEMPLATES = frozenset({
     "/metrics",
 })
 _ID_RE = re.compile(_ID)
+#: Charset a client-supplied X-Request-Id must satisfy to be echoed back
+#: (response-header injection hygiene).
+_REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._-]+")
 
 
 def route_label(method: str, path: str) -> str:
@@ -152,7 +158,8 @@ class _Handler(BaseHTTPRequestHandler):
             raise InvalidRequest(f"malformed JSON body: {e}")
 
     def _reply(self, status: int, obj=None, resource_not_found=False,
-               retry_after=None, raw=None, content_type="application/json"):
+               retry_after=None, raw=None, content_type="application/json",
+               extra_headers=None):
         if raw is not None:
             body = raw
         else:
@@ -191,7 +198,20 @@ class _Handler(BaseHTTPRequestHandler):
         # Counted BEFORE the body write: once a client has the response, the
         # counters must already reflect it (no read-after-response race).
         dt_ms = (time.perf_counter() - self._t0) * 1e3 if self._t0 else 0.0
-        log.info("%s %s -> %d (%.1fms)", self.command, self.path, status, dt_ms)
+        if status >= 400:
+            # correlate error replies with the echoed X-Request-Id so a
+            # client-side failure report can be grepped straight to the
+            # server-side record (and its trace)
+            log.info("%s %s -> %d (%.1fms) request_id=%s",
+                     self.command, self.path, status, dt_ms, self._request_id)
+        else:
+            log.info("%s %s -> %d (%.1fms)", self.command, self.path, status,
+                     dt_ms)
+        span = self._span
+        if span is not None and "http.status" not in span.attributes:
+            # first write wins: a failed body write re-enters _reply with a
+            # 500, but the status the CLIENT saw is the one already recorded
+            span.set_attribute("http.status", status)
         if not self._counted:  # a failed write re-enters _reply via the
             self._counted = True  # _route catch-all: count the request once
             counts = getattr(self.server, "status_counts", None)
@@ -211,6 +231,13 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 metrics.observe(f"http.latency.{label}", dt_ms / 1e3)
         self.send_response(status)
+        if self._request_id:
+            # echo the correlation id on EVERY response (reused from the
+            # request when the client sent one, minted server-side else)
+            self.send_header(obs.REQUEST_ID_HEADER, self._request_id)
+        if extra_headers:
+            for key, value in extra_headers.items():
+                self.send_header(key, value)
         if resource_not_found:
             self.send_header("X-Resource-Not-Found", "true")
         if retry_after is not None:
@@ -224,17 +251,19 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _reply_option(self, obj):
+    def _reply_option(self, obj, extra_headers=None):
         if obj is None:
             self._reply(404, {"error": "resource not found"}, resource_not_found=True)
         else:
-            self._reply(200, obj.to_obj())
+            self._reply(200, obj.to_obj(), extra_headers=extra_headers)
 
     _t0 = 0.0
     _counted = False
     _body_consumed = False
     _route_path = None
     _shed = False
+    _span = None
+    _request_id = None
 
     def _agent_key(self) -> str:
         """Admission key: the CLAIMED agent id (token unverified — rate
@@ -251,13 +280,23 @@ class _Handler(BaseHTTPRequestHandler):
         self._counted = False  # per-request (connections are reused)
         self._body_consumed = False
         self._shed = False
+        self._span = None
         url = urlparse(self.path)
         path = url.path.rstrip("/")
         query = parse_qs(url.query)
         self._route_path = path or "/"
+        # correlation id: reuse the client's X-Request-Id, mint one else.
+        # The value is echoed into a response header, so a hostile one must
+        # not smuggle CRLFs or unbounded bytes: token charset, capped length
+        claimed = self.headers.get(obs.REQUEST_ID_HEADER, "")
+        if not (claimed and len(claimed) <= 64
+                and _REQUEST_ID_RE.fullmatch(claimed)):
+            claimed = obs.new_request_id()
+        self._request_id = claimed
 
         # observability plane: exempt from admission (scrapes must land
-        # during the exact overload they are meant to diagnose)
+        # during the exact overload they are meant to diagnose) and from
+        # tracing (a scrape loop would churn the span ring buffer)
         if method == "GET" and path == "/metrics":
             if not getattr(self.server, "metrics_enabled", False):
                 return self._reply(404, {"error": "metrics endpoint disabled "
@@ -267,26 +306,51 @@ class _Handler(BaseHTTPRequestHandler):
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
 
-        # admission control: shed BEFORE auth/crypto/store work. A rejected
-        # request costs one header parse; Retry-After tells the retrying
-        # transport exactly when the token bucket refills.
-        admission = getattr(self.server, "admission", None)
-        if admission is not None and admission.enabled:
-            shed = admission.admit(self._agent_key())
-            if shed is not None:
-                log.debug("%s %s -> %d shed (%s, retry in %.3fs)",
-                          method, path, shed.status, shed.reason,
-                          shed.retry_after)
-                self._shed = True
-                return self._reply(
-                    shed.status, {"error": f"throttled: {shed.reason}"},
-                    retry_after=shed.retry_after,
-                )
+        # server span: joins the caller's trace when the request carries a
+        # W3C traceparent header, else roots a fresh trace. Everything the
+        # handler does — admission verdicts, service calls, store ops,
+        # snapshot phases — lands as descendants of this span.
+        label = route_label(method, self._route_path)
+        parent = obs.parse_traceparent(
+            self.headers.get(obs.TRACEPARENT_HEADER))
+        with obs.span(
+            f"http.server {label}", parent=parent, kind="server",
+            attributes={"http.method": method, "http.route": label,
+                        "request_id": self._request_id},
+        ) as server_span:
+            self._span = server_span
             try:
+                # admission control: shed BEFORE auth/crypto/store work. A
+                # rejected request costs one header parse; Retry-After tells
+                # the retrying transport exactly when the token bucket
+                # refills.
+                admission = getattr(self.server, "admission", None)
+                if admission is not None and admission.enabled:
+                    shed = admission.admit(self._agent_key())
+                    if shed is not None:
+                        log.debug("%s %s -> %d shed (%s, retry in %.3fs)",
+                                  method, path, shed.status, shed.reason,
+                                  shed.retry_after)
+                        self._shed = True
+                        server_span.set_attribute("shed", shed.reason)
+                        return self._reply(
+                            shed.status,
+                            {"error": f"throttled: {shed.reason}"},
+                            retry_after=shed.retry_after,
+                        )
+                    try:
+                        return self._dispatch(method, path, query)
+                    finally:
+                        admission.release()
                 return self._dispatch(method, path, query)
             finally:
-                admission.release()
-        return self._dispatch(method, path, query)
+                if getattr(self.server, "trace_log", False):
+                    trace_log.info(
+                        "trace %s %s %s status=%s request_id=%s",
+                        server_span.trace_id, method, self._route_path,
+                        server_span.attributes.get("http.status"),
+                        self._request_id,
+                    )
 
     def _dispatch(self, method: str, path: str, query):
         def m(pattern):
@@ -383,9 +447,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self.service.create_snapshot(caller, snap)
                 return self._reply(201)
             if path == "/v1/aggregations/any/jobs" and method == "GET":
-                return self._reply_option(
-                    self.service.get_clerking_job(caller, caller.id)
-                )
+                job = self.service.get_clerking_job(caller, caller.id)
+                headers = None
+                if job is not None:
+                    # hand the clerk the trace context the job was enqueued
+                    # under: processing (even after a lease reissue) parents
+                    # to the round that created the job, not the poll
+                    link = obs.job_link(str(job.id))
+                    if link is not None:
+                        headers = {obs.TRACE_CONTEXT_HEADER:
+                                   obs.format_traceparent(link)}
+                return self._reply_option(job, extra_headers=headers)
             if r := m(rf"/v1/aggregations/implied/jobs/({_ID})/result"):
                 if method == "POST":
                     result = ClerkingResult.from_obj(self._json_body())
@@ -467,7 +539,9 @@ class SdaHttpServer:
     layer (both default off — zero overhead and bit-compatible behavior
     with the pre-admission server); ``metrics_endpoint`` enables the
     plaintext Prometheus exposition at ``GET /metrics`` (off by default:
-    it reveals traffic shape, opt in via ``sdad --metrics``).
+    it reveals traffic shape, opt in via ``sdad --metrics``);
+    ``trace_log`` logs one INFO line per finished server span (trace id,
+    route, status, request id — ``sdad --trace``).
     """
 
     def __init__(
@@ -479,6 +553,7 @@ class SdaHttpServer:
         rate_limit: Optional[float] = None,
         rate_burst: float = 8.0,
         metrics_endpoint: bool = False,
+        trace_log: bool = False,
     ):
         host, _, port = bind.partition(":")
         self.httpd = ThreadingHTTPServer((host, int(port or 8888)), _Handler)
@@ -490,6 +565,7 @@ class SdaHttpServer:
         )
         self.httpd.admission = self.admission  # type: ignore[attr-defined]
         self.httpd.metrics_enabled = metrics_endpoint  # type: ignore[attr-defined]
+        self.httpd.trace_log = trace_log  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     def configure_admission(

@@ -25,6 +25,13 @@ end-to-end verdict: the revealed sum must still be bit-exact, and every
 *admitted* participation must be present — load shedding may slow the
 round, never corrupt it.
 
+Tracing: the whole run is one ``round`` trace (``sda_tpu.obs``); each
+simulated participant is a ``load.participant`` span parented to it, so
+the report can name the slowest participants and the exact span chain
+(retry attempts, server handling, store ops) that made them slow — the
+``trace_exemplars`` table. Export the full timeline with
+``sda-sim --load --trace-out trace.json``.
+
 Overload is a profile, not an accident: arm the server's admission layer
 (``rate_limit`` / ``max_inflight``) and the swarm gets 429+``Retry-After``
 sheds that the retrying transport converges through — zero 5xx, zero lost
@@ -41,7 +48,7 @@ import time
 from dataclasses import dataclass
 from typing import List, Optional
 
-from .. import chaos
+from .. import chaos, obs
 from ..utils import metrics
 
 
@@ -120,7 +127,7 @@ def run_load(profile: LoadProfile) -> dict:
         prime_modulus=433, omega_secrets=354, omega_shares=150,
     )
 
-    metrics.reset_all()
+    obs.reset_all()
     chaos.reset()
 
     if profile.store == "memory":
@@ -140,153 +147,174 @@ def run_load(profile: LoadProfile) -> dict:
     failures: List[str] = []
     failures_lock = threading.Lock()
     try:
-        proxy = SdaHttpClient(
-            http_server.address,
-            token="load-drill-token",
-            # generous retry budget: under the overload profile EVERY
-            # participant is expected to be shed at least once and must
-            # converge through Retry-After hints within the deadline
-            max_retries=16, backoff_base=0.01, backoff_cap=0.25,
-            deadline=profile.timeout_s,
-        )
-
-        def new_client():
-            keystore = MemoryKeystore()
-            agent = SdaClient.new_agent(keystore)
-            return SdaClient(agent, keystore, proxy)
-
-        # -- setup (unthrottled: admission armed after) -------------------
-        recipient = new_client()
-        recipient.upload_agent()
-        recipient_key = recipient.new_encryption_key()
-        recipient.upload_encryption_key(recipient_key)
-
-        candidates = {recipient.agent.id: recipient}
-        for _ in range(scheme.share_count):
-            clerk = new_client()
-            clerk.upload_agent()
-            clerk.upload_encryption_key(clerk.new_encryption_key())
-            candidates[clerk.agent.id] = clerk
-
-        agg = Aggregation(
-            id=AggregationId.random(),
-            title="load-drill",
-            vector_dimension=profile.dim,
-            modulus=scheme.prime_modulus,
-            recipient=recipient.agent.id,
-            recipient_key=recipient_key,
-            masking_scheme=FullMasking(scheme.prime_modulus),
-            committee_sharing_scheme=scheme,
-            recipient_encryption_scheme=SodiumEncryption(),
-            committee_encryption_scheme=SodiumEncryption(),
-        )
-        recipient.upload_aggregation(agg)
-        recipient.begin_aggregation(agg.id)
-        committee = recipient.service.get_committee(recipient.agent, agg.id)
-        clerks = [candidates[cid] for cid, _ in committee.clerks_and_keys]
-
-        # -- arm admission + chaos, then open the floodgates --------------
-        http_server.configure_admission(
-            max_inflight=profile.max_inflight,
-            rate_limit=profile.rate_limit,
-            rate_burst=profile.rate_burst,
-        )
-        if profile.chaos_rate > 0.0:
-            chaos.configure("http.server.request", error=True,
-                            rate=profile.chaos_rate, seed=profile.seed)
-
-        rng = np.random.default_rng(profile.seed)
-        inputs = rng.integers(0, scheme.prime_modulus,
-                              size=(profile.participants, profile.dim),
-                              dtype=np.int64)
-
-        def participant_task(index: int, scheduled: float, t_open: float):
-            start = time.perf_counter()
-            if profile.arrivals == "open":
-                metrics.observe("load.lag", max(0.0, (start - t_open) - scheduled))
-            try:
-                t0 = time.perf_counter()
-                participant = new_client()
-                participant.upload_agent()
-                metrics.observe("load.phase.register",
-                                time.perf_counter() - t0)
-                t1 = time.perf_counter()
-                participant.participate(
-                    [int(x) for x in inputs[index]], agg.id
-                )
-                metrics.observe("load.phase.participate",
-                                time.perf_counter() - t1)
-                return True
-            except Exception as e:  # tallied, not fatal: the report decides
-                with failures_lock:
-                    failures.append(f"participant {index}: "
-                                    f"{type(e).__name__}: {e}")
-                return False
-
-        arrival_rng = random.Random(profile.seed)
-        setup_requests = sum(http_server.status_counts.values())
-        t_load0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(
-            max_workers=max(1, profile.concurrency)
-        ) as pool:
-            futures = []
-            if profile.arrivals == "open":
-                # seeded Poisson arrivals: submit at the scheduled instant
-                # whether or not earlier work finished (open loop); the
-                # bounded pool then queues — the backlog shows up in
-                # load.lag, not in a silently stretched schedule
-                t_arrival = 0.0
-                for i in range(profile.participants):
-                    t_arrival += arrival_rng.expovariate(profile.target_rps)
-                    delay = t_arrival - (time.perf_counter() - t_load0)
-                    if delay > 0:
-                        time.sleep(delay)
-                    futures.append(
-                        pool.submit(participant_task, i, t_arrival, t_load0)
-                    )
-            else:
-                for i in range(profile.participants):
-                    futures.append(pool.submit(participant_task, i, 0.0, t_load0))
-            completed = sum(bool(f.result()) for f in futures)
-        load_elapsed = time.perf_counter() - t_load0
-        # the headline RPS covers ONLY the participant window: snapshot
-        # before the close phase adds clerk polling traffic
-        load_requests = sum(http_server.status_counts.values()) - setup_requests
-
-        # -- close the round: snapshot, clerking, reveal ------------------
-        recipient.end_aggregation(agg.id)
-        deadline = time.monotonic() + profile.timeout_s
-        ready = False
-        status = None
-        while time.monotonic() < deadline:
-            for clerk in clerks:
-                clerk.run_chores(-1)
-            status = recipient.service.get_aggregation_status(
-                recipient.agent, agg.id
+        with obs.span("round", attributes={"profile": "load",
+                                           "participants": profile.participants,
+                                           "arrivals": profile.arrivals,
+                                           "seed": profile.seed}) as round_span:
+            # worker threads have no thread-local context: pass the round
+            # context explicitly so every participant span joins the trace
+            round_ctx = round_span.context
+            proxy = SdaHttpClient(
+                http_server.address,
+                token="load-drill-token",
+                # generous retry budget: under the overload profile EVERY
+                # participant is expected to be shed at least once and must
+                # converge through Retry-After hints within the deadline
+                max_retries=16, backoff_base=0.01, backoff_cap=0.25,
+                deadline=profile.timeout_s,
             )
-            if (
-                status is not None
-                and status.snapshots
-                and status.snapshots[0].number_of_clerking_results
-                >= scheme.share_count
-            ):
-                ready = True
-                break
-            time.sleep(0.05)
 
-        exact = False
-        admitted_participations = None
-        if status is not None:
-            admitted_participations = status.number_of_participations
-        # zero lost participations among admitted requests: every
-        # participant whose upload was ACKed must be in the round, and
-        # with all of them in, the revealed sum must be bit-exact (a
-        # failed participant MAY still have landed server-side — lost
-        # final ack — so exactness is only decidable at zero failures)
-        if ready and completed == profile.participants:
-            output = recipient.reveal_aggregation(agg.id)
-            expected = inputs.sum(axis=0) % scheme.prime_modulus
-            exact = bool((output.positive().values == expected).all())
+            def new_client():
+                keystore = MemoryKeystore()
+                agent = SdaClient.new_agent(keystore)
+                return SdaClient(agent, keystore, proxy)
+
+            # -- setup (unthrottled: admission armed after) ---------------
+            recipient = new_client()
+            recipient.upload_agent()
+            recipient_key = recipient.new_encryption_key()
+            recipient.upload_encryption_key(recipient_key)
+
+            candidates = {recipient.agent.id: recipient}
+            for _ in range(scheme.share_count):
+                clerk = new_client()
+                clerk.upload_agent()
+                clerk.upload_encryption_key(clerk.new_encryption_key())
+                candidates[clerk.agent.id] = clerk
+
+            agg = Aggregation(
+                id=AggregationId.random(),
+                title="load-drill",
+                vector_dimension=profile.dim,
+                modulus=scheme.prime_modulus,
+                recipient=recipient.agent.id,
+                recipient_key=recipient_key,
+                masking_scheme=FullMasking(scheme.prime_modulus),
+                committee_sharing_scheme=scheme,
+                recipient_encryption_scheme=SodiumEncryption(),
+                committee_encryption_scheme=SodiumEncryption(),
+            )
+            recipient.upload_aggregation(agg)
+            recipient.begin_aggregation(agg.id)
+            committee = recipient.service.get_committee(recipient.agent, agg.id)
+            clerks = [candidates[cid] for cid, _ in committee.clerks_and_keys]
+
+            # -- arm admission + chaos, then open the floodgates ----------
+            http_server.configure_admission(
+                max_inflight=profile.max_inflight,
+                rate_limit=profile.rate_limit,
+                rate_burst=profile.rate_burst,
+            )
+            if profile.chaos_rate > 0.0:
+                chaos.configure("http.server.request", error=True,
+                                rate=profile.chaos_rate, seed=profile.seed)
+
+            rng = np.random.default_rng(profile.seed)
+            inputs = rng.integers(0, scheme.prime_modulus,
+                                  size=(profile.participants, profile.dim),
+                                  dtype=np.int64)
+
+            def participant_task(index: int, scheduled: float, t_open: float):
+                start = time.perf_counter()
+                if profile.arrivals == "open":
+                    metrics.observe("load.lag",
+                                    max(0.0, (start - t_open) - scheduled))
+                with obs.span("load.participant", parent=round_ctx,
+                              attributes={"index": index}) as pspan:
+                    try:
+                        t0 = time.perf_counter()
+                        participant = new_client()
+                        participant.upload_agent()
+                        metrics.observe("load.phase.register",
+                                        time.perf_counter() - t0)
+                        t1 = time.perf_counter()
+                        participant.participate(
+                            [int(x) for x in inputs[index]], agg.id
+                        )
+                        metrics.observe("load.phase.participate",
+                                        time.perf_counter() - t1)
+                        return True
+                    except Exception as e:
+                        # tallied, not fatal: the report decides. Mark the
+                        # span by hand — the swallowed exception never
+                        # escapes the span context, and failed participants
+                        # are exactly the exemplars the trace report must
+                        # flag
+                        pspan.status = "error"
+                        pspan.set_attribute(
+                            "error", f"{type(e).__name__}: {e}")
+                        with failures_lock:
+                            failures.append(f"participant {index}: "
+                                            f"{type(e).__name__}: {e}")
+                        return False
+
+            arrival_rng = random.Random(profile.seed)
+            setup_requests = sum(http_server.status_counts.values())
+            t_load0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, profile.concurrency)
+            ) as pool:
+                futures = []
+                if profile.arrivals == "open":
+                    # seeded Poisson arrivals: submit at the scheduled
+                    # instant whether or not earlier work finished (open
+                    # loop); the bounded pool then queues — the backlog
+                    # shows up in load.lag, not in a silently stretched
+                    # schedule
+                    t_arrival = 0.0
+                    for i in range(profile.participants):
+                        t_arrival += arrival_rng.expovariate(profile.target_rps)
+                        delay = t_arrival - (time.perf_counter() - t_load0)
+                        if delay > 0:
+                            time.sleep(delay)
+                        futures.append(
+                            pool.submit(participant_task, i, t_arrival, t_load0)
+                        )
+                else:
+                    for i in range(profile.participants):
+                        futures.append(
+                            pool.submit(participant_task, i, 0.0, t_load0))
+                completed = sum(bool(f.result()) for f in futures)
+            load_elapsed = time.perf_counter() - t_load0
+            # the headline RPS covers ONLY the participant window: snapshot
+            # before the close phase adds clerk polling traffic
+            load_requests = (sum(http_server.status_counts.values())
+                             - setup_requests)
+
+            # -- close the round: snapshot, clerking, reveal --------------
+            recipient.end_aggregation(agg.id)
+            deadline = time.monotonic() + profile.timeout_s
+            ready = False
+            status = None
+            while time.monotonic() < deadline:
+                for clerk in clerks:
+                    clerk.run_chores(-1)
+                status = recipient.service.get_aggregation_status(
+                    recipient.agent, agg.id
+                )
+                if (
+                    status is not None
+                    and status.snapshots
+                    and status.snapshots[0].number_of_clerking_results
+                    >= scheme.share_count
+                ):
+                    ready = True
+                    break
+                time.sleep(0.05)
+
+            exact = False
+            admitted_participations = None
+            if status is not None:
+                admitted_participations = status.number_of_participations
+            # zero lost participations among admitted requests: every
+            # participant whose upload was ACKed must be in the round, and
+            # with all of them in, the revealed sum must be bit-exact (a
+            # failed participant MAY still have landed server-side — lost
+            # final ack — so exactness is only decidable at zero failures)
+            if ready and completed == profile.participants:
+                output = recipient.reveal_aggregation(agg.id)
+                expected = inputs.sum(axis=0) % scheme.prime_modulus
+                exact = bool((output.positive().values == expected).all())
     finally:
         failpoint_report = chaos.report()
         chaos.reset()
@@ -347,6 +375,10 @@ def run_load(profile: LoadProfile) -> dict:
             metrics.histogram_report("load.phase.").items()
         },
         "lag_ms": _percentiles_ms(lag_summary) if lag_summary else None,
+        # the three slowest participants with the span chain that made them
+        # slow (retry attempts, server handling, store ops) — tail
+        # ATTRIBUTION, where the latency histograms only show tail SIZE
+        "trace_exemplars": obs.slowest_spans("load.participant", n=3) or None,
         "failpoints": failpoint_report or None,
         "counters": {
             k: v for k, v in counters.items()

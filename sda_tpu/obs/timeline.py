@@ -1,0 +1,146 @@
+"""Round timelines and critical paths over recorded spans.
+
+Where ``trace.py`` records the causal structure, this module answers the
+operator questions: which round was slowest, what chain of spans set its
+duration (the critical path — at each node, follow the child that finished
+last), and which chaos injections landed inside it. The secure-aggregation
+literature (Bonawitz et al., CCS 2017) shows tail stragglers dominate round
+time; these reports attribute the tail to a concrete span chain instead of
+a histogram bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .trace import Span, _lane, finished_spans
+
+
+def span_tree(spans: List[Span]):
+    """``(by_id, children, roots)`` — children sorted by start time; a span
+    whose parent is unknown (evicted from the ring buffer, or remote and
+    never recorded here) counts as a root."""
+    by_id = {s.span_id: s for s in spans}
+    children: Dict[str, List[Span]] = {}
+    roots = []
+    for s in spans:
+        if s.parent_id and s.parent_id in by_id:
+            children.setdefault(s.parent_id, []).append(s)
+        else:
+            roots.append(s)
+    for kids in children.values():
+        kids.sort(key=lambda c: c.start_s)
+    return by_id, children, roots
+
+
+def critical_path(root: Span, children: Dict[str, List[Span]]) -> List[Span]:
+    """Walk from ``root`` following, at each level, the child that ended
+    last — the chain that determined the subtree's duration."""
+    path = [root]
+    node = root
+    while True:
+        kids = children.get(node.span_id)
+        if not kids:
+            return path
+        node = max(kids, key=lambda c: c.end_s)
+        path.append(node)
+
+
+def _path_entry(s: Span) -> dict:
+    return {
+        "name": s.name,
+        "duration_ms": round((s.duration_s or 0.0) * 1e3, 3),
+    }
+
+
+def _chaos_events(spans: List[Span]) -> List[dict]:
+    out = []
+    for s in spans:
+        for ev in s.events:
+            if ev["name"].startswith("chaos."):
+                out.append({
+                    "event": ev["name"],
+                    "span": s.name,
+                    "span_id": s.span_id,
+                    **{k: v for k, v in ev["attributes"].items()},
+                })
+    return out
+
+
+def round_timelines(spans: Optional[List[Span]] = None) -> List[dict]:
+    """One timeline report per trace, slowest first: wall-clock extent,
+    span count, participating lanes, the critical path from the earliest
+    root, and every chaos injection recorded inside the trace."""
+    if spans is None:
+        spans = finished_spans()
+    by_trace: Dict[str, List[Span]] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    reports = []
+    for trace_id, members in by_trace.items():
+        _, children, roots = span_tree(members)
+        start = min(s.start_s for s in members)
+        end = max(s.end_s for s in members)
+        root = min(roots, key=lambda s: s.start_s)
+        reports.append({
+            "trace_id": trace_id,
+            "root": root.name,
+            "start_s": round(start, 6),
+            "duration_ms": round((end - start) * 1e3, 3),
+            "spans": len(members),
+            "lanes": sorted({_lane(s.name) for s in members}),
+            "critical_path": [
+                _path_entry(s) for s in critical_path(root, children)
+            ],
+            "chaos_events": _chaos_events(members),
+        })
+    reports.sort(key=lambda r: r["duration_ms"], reverse=True)
+    return reports
+
+
+def slowest_spans(
+    name: str, n: int = 3, spans: Optional[List[Span]] = None
+) -> List[dict]:
+    """Exemplars: the ``n`` slowest spans named ``name`` with the critical
+    path of their subtree — e.g. the slowest ``load.participant`` units in
+    a loadgen capacity report."""
+    if spans is None:
+        spans = finished_spans()
+    _, children, _ = span_tree(spans)
+    matches = sorted(
+        (s for s in spans if s.name == name),
+        key=lambda s: s.duration_s or 0.0,
+        reverse=True,
+    )
+    return [
+        {
+            "trace_id": s.trace_id,
+            "span_id": s.span_id,
+            "duration_ms": round((s.duration_s or 0.0) * 1e3, 3),
+            "attributes": {k: str(v) for k, v in s.attributes.items()},
+            "critical_path": [
+                _path_entry(p) for p in critical_path(s, children)
+            ],
+        }
+        for s in matches[:n]
+    ]
+
+
+def merge_chrome_traces(*traces: dict) -> dict:
+    """Concatenate Chrome trace dicts (e.g. the span export plus a
+    ``jax.profiler`` device trace loaded via ``traceparse``), remapping
+    pids so lanes from different sources never collide."""
+    events = []
+    next_pid = 0
+    for t in traces:
+        remap: Dict[object, int] = {}
+        for e in t.get("traceEvents", []):
+            e = dict(e)
+            pid = e.get("pid")
+            if pid is not None:
+                if pid not in remap:
+                    next_pid += 1
+                    remap[pid] = next_pid
+                e["pid"] = remap[pid]
+            events.append(e)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -40,6 +40,7 @@ from ..protocol import (
     SnapshotResult,
     SnapshotStatus,
 )
+from .. import obs
 from ..utils import metrics
 from . import snapshot as snapshot_mod
 from .stores import (
@@ -139,7 +140,9 @@ class SdaServer:
 
     # -- participation -----------------------------------------------------
     def create_participation(self, participation: Participation) -> None:
-        self.aggregation_store.create_participation(participation)
+        with obs.span("server.create_participation",
+                      attributes={"participation": str(participation.id)}):
+            self.aggregation_store.create_participation(participation)
         metrics.count("server.participation.created")
 
     # -- status / snapshots ------------------------------------------------
@@ -167,23 +170,31 @@ class SdaServer:
         )
 
     def create_snapshot(self, snapshot: Snapshot) -> None:
-        if snapshot_mod.snapshot(self, snapshot):
-            metrics.count("server.snapshot.created")
+        with obs.span("server.snapshot",
+                      attributes={"snapshot": str(snapshot.id),
+                                  "aggregation": str(snapshot.aggregation)}):
+            if snapshot_mod.snapshot(self, snapshot):
+                metrics.count("server.snapshot.created")
 
     # -- clerking ----------------------------------------------------------
     def poll_clerking_job(self, clerk: AgentId) -> Optional[ClerkingJob]:
-        if self.clerking_lease_seconds is not None:
-            leased = self.clerking_job_store.lease_clerking_job(
-                clerk, self.clerking_lease_seconds
-            )
-            job = None
-            if leased is not None:
-                job, _expires = leased
-                metrics.count("server.job.leased")
-        else:
-            job = self.clerking_job_store.poll_clerking_job(clerk)
-        metrics.count("server.job.polled" if job else "server.job.poll_empty")
-        return job
+        with obs.span("server.poll_job",
+                      attributes={"clerk": str(clerk)}) as poll_span:
+            if self.clerking_lease_seconds is not None:
+                leased = self.clerking_job_store.lease_clerking_job(
+                    clerk, self.clerking_lease_seconds
+                )
+                job = None
+                if leased is not None:
+                    job, _expires = leased
+                    poll_span.set_attribute("leased", True)
+                    metrics.count("server.job.leased")
+            else:
+                job = self.clerking_job_store.poll_clerking_job(clerk)
+            if job is not None:
+                poll_span.set_attribute("job", str(job.id))
+            metrics.count("server.job.polled" if job else "server.job.poll_empty")
+            return job
 
     def get_clerking_job(
         self, clerk: AgentId, job: ClerkingJobId
@@ -191,7 +202,9 @@ class SdaServer:
         return self.clerking_job_store.get_clerking_job(clerk, job)
 
     def create_clerking_result(self, result: ClerkingResult) -> None:
-        self.clerking_job_store.create_clerking_result(result)
+        with obs.span("server.create_result",
+                      attributes={"job": str(result.job)}):
+            self.clerking_job_store.create_clerking_result(result)
         metrics.count("server.clerking_result.created")
 
     def get_snapshot_result(

@@ -31,6 +31,7 @@ from ..protocol import (
     PackedPaillierEncryption,
     Snapshot,
 )
+from .. import obs
 from ..utils import metrics, timed_phase
 
 log = logging.getLogger(__name__)
@@ -137,16 +138,20 @@ def _snapshot_locked(server, snap: Snapshot) -> bool:
 
     log.debug("snapshot %s: enqueueing %d clerking jobs", snap.id, len(columns))
     with timed_phase("server.enqueue_jobs"):
+        enqueue_ctx = obs.current_context()
         for (clerk_id, _), encryptions in zip(committee.clerks_and_keys, columns):
-            server.clerking_job_store.enqueue_clerking_job(
-                ClerkingJob(
-                    id=clerking_job_id(snap.id, clerk_id),
-                    clerk=clerk_id,
-                    aggregation=snap.aggregation,
-                    snapshot=snap.id,
-                    encryptions=encryptions,
-                )
+            job = ClerkingJob(
+                id=clerking_job_id(snap.id, clerk_id),
+                clerk=clerk_id,
+                aggregation=snap.aggregation,
+                snapshot=snap.id,
+                encryptions=encryptions,
             )
+            # remember which trace enqueued each job: clerk-side processing
+            # (including a lease-reissued retry of the same deterministic
+            # job id) re-parents to this round instead of its own poll
+            obs.link_job(str(job.id), enqueue_ctx)
+            server.clerking_job_store.enqueue_clerking_job(job)
 
     if aggregation.masking_scheme.has_mask:
         log.debug("snapshot %s: collecting recipient mask encryptions", snap.id)

@@ -59,13 +59,20 @@ _stats: Dict[str, PhaseStat] = {}
 
 @contextlib.contextmanager
 def timed_phase(name: str) -> Iterator[None]:
-    """Time a protocol phase and annotate it on any active profiler trace."""
+    """Time a protocol phase, annotate it on any active profiler trace, and
+    record it as a span in the distributed-tracing layer (``sda_tpu.obs``)
+    so the phase joins the round's causal timeline, parented to whatever
+    span is active on this thread (an HTTP server span, a client role
+    span, ...)."""
     import jax.profiler
+
+    from .. import obs
 
     start = time.perf_counter()
     try:
         with jax.profiler.TraceAnnotation(name):
-            yield
+            with obs.span(name):
+                yield
     finally:
         elapsed = time.perf_counter() - start
         with _lock:
