@@ -43,10 +43,15 @@ from sda_tpu.utils.backend import use_platform
 def _run(platform: str, use_pallas: bool) -> dict:
     import jax
 
+    from sda_tpu.obs import devprof
     from sda_tpu.utils.backend import enable_compile_cache
 
     use_platform(platform)
     enable_compile_cache(platform)  # windows must not re-pay compiles
+    # device perf plane: compile counters + cache hit/miss + per-shape
+    # cost analysis feeding the roofline block in the bench JSON
+    devprof.install_monitoring()
+    devprof.enable_cost_analysis()
 
     import jax.numpy as jnp
     import numpy as np
@@ -76,12 +81,13 @@ def _run(platform: str, use_pallas: bool) -> dict:
         from sda_tpu.utils.benchtime import pallas_knobs, tree_fold_knob
 
         p_block, tile = pallas_knobs()
-        fn = jax.jit(single_chip_round_pallas(
+        fn = devprof.instrument("bench.round", jax.jit(single_chip_round_pallas(
             scheme, FullMasking(p), p_block=p_block, tile=tile,
             tree_fold=tree_fold_knob(),
-        ))
+        )))
     else:
-        fn = jax.jit(single_chip_round(scheme, FullMasking(p)))
+        fn = devprof.instrument(
+            "bench.round", jax.jit(single_chip_round(scheme, FullMasking(p))))
 
     # uint32 inputs halve HBM traffic and skip the emulated-s64 residue
     # pass (_to_residues32 fast path); wire values are < 2^20 anyway
@@ -126,6 +132,15 @@ def _run(platform: str, use_pallas: bool) -> dict:
         "compile_seconds": round(compile_s, 1),
         **timing,
     }
+    # roofline block: one round's worth of FLOPs/bytes (cost_analysis of
+    # the compiled round) against the RTT-cancelled marginal round time,
+    # vs the chip peaks pinned in benchmarks/ROOFLINE.md. xla block:
+    # compile counts, compile-seconds histogram, persistent-cache
+    # hit/miss — whether this window actually skipped its compiles.
+    result["roofline"] = devprof.roofline(
+        seconds=per_round, names=("bench.round",), basis="per_call",
+        platform=dev.platform)
+    result["xla"] = devprof.compile_totals()
 
     # -- streamed execution of the SAME round ----------------------------
     # The dim-chunked scan has better locality than the full-width round

@@ -59,6 +59,30 @@ print(f"trace smoke OK: {len(spans)} spans, {round_traces} connected round trace
 PY
 rm -f "$TRACE_OUT"
 
+echo "== device perf plane (fixed seed: roofline block + compile counters + advisory regression gate)"
+PERF_REPORT=$(env SDA_SIM_PLATFORM=cpu JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim \
+  --participants 16 --dim 96 --clerks 8 --verify)
+PERF_REPORT="$PERF_REPORT" python - <<'PY'
+import json, os
+report = json.loads(os.environ["PERF_REPORT"].strip().splitlines()[-1])
+assert report["exact"], report
+roof = report["roofline"]  # the block must parse with all four fields
+assert roof["flops"] > 0 and roof["bytes"] > 0, roof
+assert roof["arithmetic_intensity"] > 0, roof
+assert 0 < roof["utilization"] < 1, roof
+assert roof["hbm_peak_bytes"] > 0, roof
+compile_counters = {k: v for k, v in report["counters"].items()
+                    if k.startswith("xla.compile.")}
+assert compile_counters, report["counters"]
+assert report["xla"]["functions"]["mesh.simpod.round"]["retraces"] == 0
+print(f"device perf plane OK: AI={roof['arithmetic_intensity']}, "
+      f"utilization={roof['utilization']} ({roof['platform']} peaks), "
+      f"compile counters {compile_counters}")
+PY
+# advisory on CPU: CPU rung numbers are not gated, but a malformed
+# committed record still fails CI (exit 2)
+python -m sda_tpu.obs.regress --advisory BENCH_r*.json
+
 echo "== CLI walkthrough (real sdad + sda over HTTP)"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu bash docs/walkthrough.sh | tail -1 | {
   read -r reveal

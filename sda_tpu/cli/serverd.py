@@ -33,6 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="serve Prometheus text exposition (counters + "
                              "latency histogram buckets) at GET /metrics "
                              "(off by default)")
+    parser.add_argument("--statusz", action="store_true",
+                        help="serve the JSON debug page at GET /statusz "
+                             "(uptime, store backend, in-flight/peak "
+                             "gauges, job-lease stats, devprof compile "
+                             "totals; off by default)")
     parser.add_argument("--trace", action="store_true",
                         help="log one INFO line per finished request span "
                              "(trace id, route, status, X-Request-Id); "
@@ -89,6 +94,7 @@ def main(argv=None) -> int:
         rate_limit=args.rate_limit,
         rate_burst=args.rate_burst,
         metrics_endpoint=args.metrics,
+        statusz_endpoint=args.statusz,
         trace_log=args.trace,
     )
     if args.trace:

@@ -414,6 +414,14 @@ def main(argv=None) -> int:
         inputs = rng.integers(0, 1 << 20, size=(args.participants, dim),
                               dtype=np.int64)
     obs.reset_all()
+    # device perf plane: compile/retrace counters + (entry-point opt-in)
+    # cost analysis feeding the roofline block below. SDA_DEVPROF_COST=0
+    # disables the extra ahead-of-time compile per shape.
+    from ..obs import devprof
+
+    devprof.install_monitoring()
+    devprof.enable_cost_analysis()
+    wall_start = time.perf_counter()
     key = jax.random.PRNGKey(0)
     if coord is not None:
         from ..mesh import StreamedPod, make_multislice_mesh, multihost as mh
@@ -504,6 +512,13 @@ def main(argv=None) -> int:
     if phases:
         result["phases_s"] = {name: round(stat["total_s"], 4)
                               for name, stat in phases.items()}
+    # roofline block: cost-analysis totals over BOTH rounds (the first
+    # includes compile) against the wall clock of the whole measured
+    # region — per-phase FLOPs/bytes/AI plus utilization vs the chip
+    # peaks (benchmarks/ROOFLINE.md; CPU peaks are nominal, advisory)
+    result["roofline"] = devprof.roofline(
+        seconds=time.perf_counter() - wall_start)
+    result["xla"] = devprof.compile_totals()
     counters = counter_report()
     if counters:
         result["counters"] = counters

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from . import fastfield
+from ..obs import devprof
 from .modular import modmatmul, modsub, modsum, uniform_mod
 
 
@@ -58,6 +59,13 @@ def additive_share_from_randomness(secrets, draws, *, modulus: int):
     return jnp.concatenate([draws, last[..., None, :]], axis=-2)
 
 
+# devprof compiled-shape registry on the jit entry points: calls from
+# inside an outer trace (the pod/streamed programs) pass through uncounted
+# under a named scope; top-level calls (the federated client path) count
+additive_share_from_randomness = devprof.instrument(
+    "fields.additive_share", additive_share_from_randomness)
+
+
 def additive_share(key, secrets, *, share_count: int, modulus: int):
     """[..., d] secrets -> [..., n, d] shares with fresh threefry draws."""
     d = secrets.shape[-1]
@@ -70,6 +78,9 @@ def combine(shares, *, modulus: int):
     """Elementwise modular sum across the leading axis — the clerk hot kernel
     (combiner.rs:15-30) and the additive reconstructor (additive.rs:55-73)."""
     return modsum(shares, modulus, axis=0)
+
+
+combine = devprof.instrument("fields.combine", combine)
 
 
 # ---------------------------------------------------------------------------
@@ -89,6 +100,10 @@ def packed_share_from_randomness(secrets, randomness, share_matrix, *, prime: in
     zeros = jnp.zeros(sk.shape[:-2] + (1,) + sk.shape[-1:], sk.dtype)
     values = jnp.concatenate([zeros, sk, randomness], axis=-2)   # [..., m2, B]
     return modmatmul(share_matrix, values, prime)                # [..., n, B]
+
+
+packed_share_from_randomness = devprof.instrument(
+    "fields.packed_share", packed_share_from_randomness)
 
 
 def packed_share(key, secrets, share_matrix, *, prime: int, secret_count: int,
@@ -144,3 +159,7 @@ def packed_reconstruct(shares, recon_matrix, *, prime: int, dimension: int):
     values = jnp.concatenate([zeros, shares], axis=0)            # [r+1, B]
     secrets = modmatmul(recon_matrix, values, prime)             # [k, B]
     return unbatch_columns(secrets, dimension)
+
+
+packed_reconstruct = devprof.instrument(
+    "fields.packed_reconstruct", packed_reconstruct)

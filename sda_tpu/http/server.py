@@ -100,6 +100,7 @@ _ROUTE_TEMPLATES = frozenset({
     "/v1/aggregations/implied/jobs/{id}/result",
     "/v1/aggregations/{id}/snapshots/{id}/result",
     "/metrics",
+    "/statusz",
 })
 _ID_RE = re.compile(_ID)
 #: Charset a client-supplied X-Request-Id must satisfy to be echoed back
@@ -305,6 +306,12 @@ class _Handler(BaseHTTPRequestHandler):
                 200, raw=metrics.prometheus_text().encode("utf-8"),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
+        if method == "GET" and path == "/statusz":
+            statusz = getattr(self.server, "statusz_fn", None)
+            if statusz is None:
+                return self._reply(404, {"error": "statusz endpoint disabled "
+                                                  "(sdad --statusz)"})
+            return self._reply(200, statusz())
 
         # server span: joins the caller's trace when the request carries a
         # W3C traceparent header, else roots a fresh trace. Everything the
@@ -540,6 +547,9 @@ class SdaHttpServer:
     with the pre-admission server); ``metrics_endpoint`` enables the
     plaintext Prometheus exposition at ``GET /metrics`` (off by default:
     it reveals traffic shape, opt in via ``sdad --metrics``);
+    ``statusz_endpoint`` enables the ``GET /statusz`` JSON debug page
+    (uptime, store backend, in-flight/peak gauges, lease stats, devprof
+    compile totals — same opt-in reasoning, ``sdad --statusz``);
     ``trace_log`` logs one INFO line per finished server span (trace id,
     route, status, request id — ``sdad --trace``).
     """
@@ -553,6 +563,7 @@ class SdaHttpServer:
         rate_limit: Optional[float] = None,
         rate_burst: float = 8.0,
         metrics_endpoint: bool = False,
+        statusz_endpoint: bool = False,
         trace_log: bool = False,
     ):
         host, _, port = bind.partition(":")
@@ -565,8 +576,36 @@ class SdaHttpServer:
         )
         self.httpd.admission = self.admission  # type: ignore[attr-defined]
         self.httpd.metrics_enabled = metrics_endpoint  # type: ignore[attr-defined]
+        self.httpd.statusz_fn = (  # type: ignore[attr-defined]
+            self.statusz if statusz_endpoint else None)
         self.httpd.trace_log = trace_log  # type: ignore[attr-defined]
+        self._started_at = time.time()
         self._thread: Optional[threading.Thread] = None
+
+    def statusz(self) -> dict:
+        """The ``GET /statusz`` payload: liveness + capacity + device-perf
+        state in one scrape (served only when the endpoint is enabled —
+        like ``/metrics`` it reveals traffic shape)."""
+        from ..obs import devprof
+
+        service = self.httpd.sda_service  # type: ignore[attr-defined]
+        gauges = metrics.gauge_report("http.inflight")
+        return {
+            "uptime_s": round(time.time() - self._started_at, 3),
+            # backend module name ("memory"/"sqlite"/"jsonfs"/"mongo")
+            "store": type(service.server.agents_store).__module__
+            .rsplit(".", 1)[-1],
+            "inflight": gauges.get("http.inflight", 0),
+            "inflight_peak": gauges.get("http.inflight.peak", 0),
+            "admission_enabled": self.admission.enabled,
+            "requests": self.status_counts,
+            "lease": {
+                "lease_seconds": service.server.clerking_lease_seconds,
+                "counters": metrics.counter_report("server.job."),
+            },
+            "devprof": devprof.compile_totals(),
+            "hbm": metrics.gauge_report("device.hbm."),
+        }
 
     def configure_admission(
         self,

@@ -53,6 +53,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..fields import chacha_jax, fastfield, numtheory, sharing
 from ..fields.ops import FieldOps
+from ..obs import devprof
 from ..utils import timed_phase
 from ..protocol import (
     AdditiveSharing,
@@ -68,6 +69,20 @@ from ..protocol import (
 #: schemes whose share/reconstruct are host-built matrices applied as
 #: device matmuls (numtheory.share_matrix_for / reconstruct_matrix_for)
 SHAMIR_SCHEMES = (PackedShamirSharing, BasicShamirSharing)
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` with per-shard checking off, falling back to the
+    pre-0.5 ``jax.experimental.shard_map`` spelling (same semantics, the
+    check flag was named ``check_rep``) so the mesh modes run on either
+    jax generation present across this repo's environments."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
 
 
 # re-export: lives in fields.fastfield (pure field arithmetic); kept under
@@ -205,19 +220,22 @@ def _mask_stage(masking, f: FieldOps, x, key, round_key, pid_base, d_block0):
     (= global_dim_offset / 8). Both may be traced.
     """
     S, d_loc = x.shape
-    if isinstance(masking, FullMasking):
-        mkey, skey = jax.random.split(key)
-        masks = f.uniform(mkey, (S, d_loc))
-    elif isinstance(masking, ChaChaMasking):
-        skey = key
-        gids = pid_base + jnp.arange(S)
-        seeds = _chacha_seed_words(round_key, gids, masking.seed_bitsize)
-        draws = chacha_jax.stream_u64_at(seeds, d_block0, dimension=d_loc)
-        masks = f.from_u64(draws)
-    else:
-        return x, None, key
-    masked = f.add(x, masks)
-    return masked, f.sum(masks, axis=0), skey
+    # named scope: the mask stage's ops land on a "sda.mask"-prefixed XProf
+    # device lane, so merged traces attribute device time to the phase
+    with jax.named_scope("sda.mask"):
+        if isinstance(masking, FullMasking):
+            mkey, skey = jax.random.split(key)
+            masks = f.uniform(mkey, (S, d_loc))
+        elif isinstance(masking, ChaChaMasking):
+            skey = key
+            gids = pid_base + jnp.arange(S)
+            seeds = _chacha_seed_words(round_key, gids, masking.seed_bitsize)
+            draws = chacha_jax.stream_u64_at(seeds, d_block0, dimension=d_loc)
+            masks = f.from_u64(draws)
+        else:
+            return x, None, key
+        masked = f.add(x, masks)
+        return masked, f.sum(masks, axis=0), skey
 
 
 def _share_sum_stage(scheme, f: FieldOps, M_host, masked, skey):
@@ -236,25 +254,26 @@ def _share_sum_stage(scheme, f: FieldOps, M_host, masked, skey):
     tests/test_mesh.py and test_fast_rounds.py pin this equivalence.
     """
     S, d = masked.shape
-    if isinstance(scheme, SHAMIR_SCHEMES):
-        k, t = scheme.secret_count, scheme.privacy_threshold
-        B = -(-d // k)
-        rand = f.uniform(skey, (S, t, B))
-        rsum = f.sum(rand, axis=0)                             # [t, B]
-        sk = sharing.batch_columns(f.sum(masked, axis=0), k)   # [k, B]
-        zeros = jnp.zeros((1, B), sk.dtype)
-        values = jnp.concatenate([zeros, sk, rsum], axis=0)    # [m2, B]
-        if f.sp is not None:
-            return fastfield.modmatmul32(M_host, values, f.sp)
-        from ..fields import modular
+    with jax.named_scope("sda.share"):
+        if isinstance(scheme, SHAMIR_SCHEMES):
+            k, t = scheme.secret_count, scheme.privacy_threshold
+            B = -(-d // k)
+            rand = f.uniform(skey, (S, t, B))
+            rsum = f.sum(rand, axis=0)                             # [t, B]
+            sk = sharing.batch_columns(f.sum(masked, axis=0), k)   # [k, B]
+            zeros = jnp.zeros((1, B), sk.dtype)
+            values = jnp.concatenate([zeros, sk, rsum], axis=0)    # [m2, B]
+            if f.sp is not None:
+                return fastfield.modmatmul32(M_host, values, f.sp)
+            from ..fields import modular
 
-        return modular.modmatmul(jnp.asarray(M_host), values, f.m)
-    # additive: Σ_p last_p = Σ_p masked_p - Σ over all draws
-    n = scheme.share_count
-    draws = f.uniform(skey, (S, n - 1, d))
-    dsum = f.sum(draws, axis=0)                                # [n-1, d]
-    last = f.sub(f.sum(masked, axis=0), f.sum(dsum, axis=0))   # [d]
-    return jnp.concatenate([dsum, last[None, :]], axis=0)
+            return modular.modmatmul(jnp.asarray(M_host), values, f.m)
+        # additive: Σ_p last_p = Σ_p masked_p - Σ over all draws
+        n = scheme.share_count
+        draws = f.uniform(skey, (S, n - 1, d))
+        dsum = f.sum(draws, axis=0)                                # [n-1, d]
+        last = f.sub(f.sum(masked, axis=0), f.sum(dsum, axis=0))   # [d]
+        return jnp.concatenate([dsum, last[None, :]], axis=0)
 
 
 def _pallas_supported(scheme, masking, f: FieldOps) -> bool:
@@ -354,11 +373,12 @@ def _pallas_stage(scheme, f: FieldOps, M_host, masking, x, dev_key, *,
     if external_bits_fn is not None:
         draws = (k + t) if masked else t
         ext = external_bits_fn(dev_key, S, draws, B0 + pad)
-    shares, mask_tot = pallas_round.fused_mask_share_combine(
-        x_cols, seed, f.sp, M_host, t, masked,
-        tile=tile, external_bits=ext, interpret=interpret, p_block=p_block,
-        tree_fold=tree_fold_knob(),
-    )
+    with jax.named_scope("sda.mask_share"):
+        shares, mask_tot = pallas_round.fused_mask_share_combine(
+            x_cols, seed, f.sp, M_host, t, masked,
+            tile=tile, external_bits=ext, interpret=interpret,
+            p_block=p_block, tree_fold=tree_fold_knob(),
+        )
     shares = shares[:, :B0]
     if not masked:
         return shares, chacha_mask_sum
@@ -408,16 +428,17 @@ def _scan_combine(f: FieldOps, scheme, masking, M_host, x, key, round_key,
 
 def _reconstruct_stage(scheme, f: FieldOps, L_host, gathered, d_loc: int):
     """[n, B] clerk rows -> [d_loc] masked totals."""
-    if isinstance(scheme, SHAMIR_SCHEMES):
-        if f.sp is not None:
-            return sharing.packed_reconstruct32(
-                gathered, L_host, f.sp, dimension=d_loc
+    with jax.named_scope("sda.reconstruct"):
+        if isinstance(scheme, SHAMIR_SCHEMES):
+            if f.sp is not None:
+                return sharing.packed_reconstruct32(
+                    gathered, L_host, f.sp, dimension=d_loc
+                )
+            return sharing.packed_reconstruct(
+                gathered, jnp.asarray(L_host),
+                prime=scheme.prime_modulus, dimension=d_loc,
             )
-        return sharing.packed_reconstruct(
-            gathered, jnp.asarray(L_host),
-            prime=scheme.prime_modulus, dimension=d_loc,
-        )
-    return f.sum(gathered, axis=0)  # additive: plain share sum
+        return f.sum(gathered, axis=0)  # additive: plain share sum
 
 
 def _dim_grain(scheme, masking) -> int:
@@ -566,13 +587,14 @@ class SimulatedPod:
 
         # snapshot transpose + clerk combine == one psum_scatter over ICI:
         # clerk axis is split across 'p' while partial sums are combined
-        clerk_rows = jax.lax.psum_scatter(
-            local_sum, "p", scatter_dimension=0, tiled=True
-        )                                                          # [n/p, B_loc]
-        clerk_rows = f.canon(clerk_rows)
+        with jax.named_scope("sda.clerk_combine"):
+            clerk_rows = jax.lax.psum_scatter(
+                local_sum, "p", scatter_dimension=0, tiled=True
+            )                                                      # [n/p, B_loc]
+            clerk_rows = f.canon(clerk_rows)
 
-        # recipient gathers all clerk rows (clerk -> recipient leg)
-        gathered = jax.lax.all_gather(clerk_rows, "p", axis=0, tiled=True)
+            # recipient gathers all clerk rows (clerk -> recipient leg)
+            gathered = jax.lax.all_gather(clerk_rows, "p", axis=0, tiled=True)
 
         if self.surviving_clerks is not None:
             # clerk dropout: reveal from the quorum's rows only — lost
@@ -582,10 +604,11 @@ class SimulatedPod:
             self.scheme, f, self._L_host, gathered, d_loc
         )                                                          # [d_loc]
 
-        if local_mask_sum is None:
-            return f.to_int64(masked_total)
-        mask_total = f.canon(jax.lax.psum(local_mask_sum, "p"))
-        return f.to_int64(f.sub(masked_total, mask_total))
+        with jax.named_scope("sda.unmask"):
+            if local_mask_sum is None:
+                return f.to_int64(masked_total)
+            mask_total = f.canon(jax.lax.psum(local_mask_sum, "p"))
+            return f.to_int64(f.sub(masked_total, mask_total))
 
     def _build(self, P_total: int, d_total: int):
         p_shards, d_shards = self.mesh.devices.shape
@@ -597,14 +620,16 @@ class SimulatedPod:
                 f"dimension {d_total} must be divisible by the scheme/mesh "
                 f"grain {grain}"
             )
-        fn = jax.shard_map(
+        fn = _shard_map(
             self._local_round,
             mesh=self.mesh,
             in_specs=(P("p", "d"), P()),
             out_specs=P("d"),
-            check_vma=False,
         )
-        return jax.jit(fn)
+        # devprof: compiled-shape registry + retrace span events + (opt-in)
+        # cost analysis for the roofline block — one profile entry for the
+        # whole SPMD round regardless of how many shapes get built
+        return devprof.instrument("mesh.simpod.round", jax.jit(fn))
 
     def padded_shape(self, P_total: int, d_total: int) -> Tuple[int, int]:
         p_shards, d_shards = self.mesh.devices.shape
@@ -699,9 +724,10 @@ def single_chip_round(
         # share + clerk combine fused via linearity (see _share_sum_stage)
         combined = _share_sum_stage(scheme, f, M_host, masked, skey)  # [n, B]
         masked_total = _reconstruct_stage(scheme, f, L_host, combined, d_loc)
-        if mask_total is None:
-            return f.to_int64(masked_total)
-        return f.to_int64(f.sub(masked_total, mask_total))
+        with jax.named_scope("sda.unmask"):
+            if mask_total is None:
+                return f.to_int64(masked_total)
+            return f.to_int64(f.sub(masked_total, mask_total))
 
     if dim_tile is None:
         def round_fn(inputs, key):

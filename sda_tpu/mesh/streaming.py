@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..fields.ops import FieldOps
+from ..obs import devprof
 from ..protocol import (
     ChaChaMasking,
     FullMasking,
@@ -58,6 +59,7 @@ from .simpod import (
     _reconstruct_stage,
     _resolve_pallas,
     _scheme_modulus,
+    _shard_map,
     _share_sum_stage,
     _tile_key,
 )
@@ -503,7 +505,11 @@ class StreamingAggregator:
                 acc_mask = f.add(acc_mask, mask_sum)
             return acc_shares, acc_mask
 
-        return jax.jit(step, donate_argnums=(5, 6))
+        # one "stream.step" profile for every block shape: the compiled-
+        # shape registry is how the "at most 2-3 shapes per axis" claim
+        # stays a tested property instead of a docstring
+        return devprof.instrument("stream.step",
+                                  jax.jit(step, donate_argnums=(5, 6)))
 
     def _final_fn(self, d_size):
         s, f = self.scheme, self._field
@@ -518,7 +524,8 @@ class StreamingAggregator:
                 total = f.sub(total, acc_mask)
             return f.to_int64(total)
 
-        return jax.jit(final, donate_argnums=(0, 1))
+        return devprof.instrument("stream.finale",
+                                  jax.jit(final, donate_argnums=(0, 1)))
 
     # -- checkpoint/resume -----------------------------------------------
     # The reference is durable-by-construction (every protocol object is a
@@ -704,14 +711,14 @@ class StreamedPod:
                 acc_mask = f.add(acc_mask, local_mask_sum[None, :])
             return acc_shares, acc_mask
 
-        fn = jax.shard_map(
+        fn = _shard_map(
             local_step,
             mesh=self.mesh,
             in_specs=(P("p", "d"), P(), P(), P(), P(), P("p", "d"), P("p", "d")),
             out_specs=(P("p", "d"), P("p", "d")),
-            check_vma=False,
         )
-        return jax.jit(fn, donate_argnums=(5, 6))
+        return devprof.instrument("stream.pod.step",
+                                  jax.jit(fn, donate_argnums=(5, 6)))
 
     def _final_fn(self, d_size: int):
         f, s = self._field, self.scheme
@@ -719,11 +726,13 @@ class StreamedPod:
 
         def local_final(acc_shares, acc_mask):
             d_loc = acc_mask.shape[-1]
-            clerk_rows = jax.lax.psum_scatter(
-                acc_shares, "p", scatter_dimension=0, tiled=True
-            )
-            clerk_rows = f.canon(clerk_rows)
-            gathered = jax.lax.all_gather(clerk_rows, "p", axis=0, tiled=True)
+            with jax.named_scope("sda.clerk_combine"):
+                clerk_rows = jax.lax.psum_scatter(
+                    acc_shares, "p", scatter_dimension=0, tiled=True
+                )
+                clerk_rows = f.canon(clerk_rows)
+                gathered = jax.lax.all_gather(
+                    clerk_rows, "p", axis=0, tiled=True)
             if self.surviving_clerks is not None:
                 # clerk dropout: rows hosted on a lost device/process never
                 # enter the reconstruct — the quorum reveals exactly
@@ -736,14 +745,14 @@ class StreamedPod:
             mask_total = f.canon(jax.lax.psum(acc_mask[0], "p"))
             return f.to_int64(f.sub(masked_total, mask_total))
 
-        fn = jax.shard_map(
+        fn = _shard_map(
             local_final,
             mesh=self.mesh,
             in_specs=(P("p", "d"), P("p", "d")),
             out_specs=P("d"),
-            check_vma=False,
         )
-        return jax.jit(fn, donate_argnums=(0, 1))
+        return devprof.instrument("stream.pod.finale",
+                                  jax.jit(fn, donate_argnums=(0, 1)))
 
     # -- driver ----------------------------------------------------------
     def aggregate_blocks(

@@ -60,7 +60,13 @@ class LocalTrainer:
                 step, (params, opt_state), batches)
             return params, opt_state, jnp.mean(losses)
 
-        self._fit = jax.jit(fit)
+        # devprof registry: a cohort whose batch shapes drift (ragged local
+        # datasets) retraces this program once per shape — the compiled-
+        # shape registry and retrace span events make that visible instead
+        # of silently serializing compile time into the round
+        from ..obs import devprof
+
+        self._fit = devprof.instrument("models.local_fit", jax.jit(fit))
 
     def init_state(self, params):
         return self.optimizer.init(params)

@@ -37,6 +37,7 @@ from .timeline import (
     slowest_spans,
     span_tree,
 )
+from . import devprof
 
 __all__ = [
     "REQUEST_ID_HEADER",
@@ -48,6 +49,7 @@ __all__ = [
     "add_event",
     "chrome_trace",
     "critical_path",
+    "devprof",
     "current_context",
     "current_span",
     "export_chrome_trace",
@@ -71,8 +73,10 @@ __all__ = [
 
 def reset_all() -> None:
     """Clear EVERY observability registry together — counters, gauges,
-    histograms, phase stats, the span ring buffer, and job-trace links —
-    so a fresh measurement window can never start half-reset
+    histograms, phase stats, the span ring buffer, job-trace links, and
+    the devprof compiled-shape/cost registry (whose ``xla.compile.*``
+    counters and HBM gauges live in the metrics registry) — so a fresh
+    measurement window can never start half-reset
     (``utils/metrics.reset_all()`` + ``reset_phase_report()`` used to be
     separate calls and easy to desync in tests)."""
     from ..utils import metrics, timing
@@ -80,3 +84,4 @@ def reset_all() -> None:
     metrics.reset_all()
     timing.reset_phase_report()
     reset_spans()
+    devprof.reset()

@@ -1,0 +1,558 @@
+"""Device observability plane: compile/retrace telemetry + cost analysis.
+
+The span layer (``trace.py``) made the HOST side of a round observable;
+this module does the same for the DEVICE side, where an unexpected XLA
+retrace, a compile-cache miss, or a phase falling off the roofline used
+to show up only as "the round got slower". Three instruments:
+
+- **Compile/retrace telemetry** — ``instrument(name, fn)`` wraps a jitted
+  callable with a compiled-shape registry: per-function call/compile
+  counts, the set of distinct argument signatures (shapes + dtypes +
+  static values), and a *retrace* detector. A retrace — a compile after
+  the function already compiled once — increments ``xla.compile.retrace``
+  and lands as an ``xla.retrace`` span event in the PR 3 trace, so the
+  round timeline shows exactly which dispatch paid a mid-round compile.
+  ``install_monitoring()`` additionally taps ``jax.monitoring`` for the
+  process-wide ``xla.compile.backend`` counter, the ``xla.compile.seconds``
+  histogram, and the persistent-cache ``xla.compile.cache.hit``/``.miss``
+  counters (the cache ``utils/backend.py::enable_compile_cache`` arms).
+
+- **Cost analysis / roofline** — with ``enable_cost_analysis()`` on (an
+  entry-point opt-in: it costs one extra ahead-of-time compile per new
+  shape), every first-per-shape call also runs
+  ``fn.lower(...).compile().cost_analysis()`` / ``memory_analysis()``,
+  recording per-phase FLOPs, bytes accessed, and the executable's peak
+  HBM footprint (``device.hbm.peak_bytes`` gauges). ``roofline()`` folds
+  those into the bench-JSON ``roofline`` block: arithmetic intensity and
+  utilization against the chip peaks pinned in ``benchmarks/ROOFLINE.md``.
+
+- **Device-lane attribution** — the round stages run under
+  ``jax.named_scope`` (``sda.mask``/``sda.share``/``sda.clerk_combine``/
+  ``sda.reconstruct``/``sda.unmask``, see ``mesh/simpod.py``), so XProf
+  device lanes merged via ``obs.merge_chrome_traces`` attribute device
+  time to protocol phases by name.
+
+No ``jax`` import happens at module import time: the HTTP/loadgen
+profiles use ``obs`` without JAX, and a bare import must stay free.
+State resets through ``obs.reset_all()``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..utils import metrics
+from . import trace as _trace
+
+__all__ = [
+    "CHIP_PEAKS",
+    "FnProfile",
+    "compile_totals",
+    "cost_analysis_enabled",
+    "enable_cost_analysis",
+    "install_monitoring",
+    "instrument",
+    "profile",
+    "report",
+    "reset",
+    "roofline",
+    "roofline_block",
+]
+
+#: Chip peaks for the roofline model, per platform family. The tpu row is
+#: the v5e bound from benchmarks/ROOFLINE.md (VPU int32 ~6e12 ops/s, HBM
+#: 819 GB/s); the cpu row is a nominal placeholder so CPU fallback runs
+#: still produce a finite utilization — CPU numbers are advisory and are
+#: never read against the north-star (ROOFLINE.md "CPU fallback" note).
+#: Override with SDA_ROOFLINE_PEAK_FLOPS / SDA_ROOFLINE_PEAK_BW.
+CHIP_PEAKS = {
+    "tpu": {
+        "flops_per_s": 6.0e12,
+        "hbm_bytes_per_s": 819e9,
+        "source": "benchmarks/ROOFLINE.md (v5e VPU int32, HBM)",
+    },
+    "cpu": {
+        "flops_per_s": 1.0e11,
+        "hbm_bytes_per_s": 5.0e10,
+        "source": "nominal CPU placeholder — utilization advisory only",
+    },
+}
+
+_lock = threading.Lock()
+_profiles: "Dict[str, FnProfile]" = {}
+_cost_enabled = False
+_monitoring_installed = False
+
+
+class FnProfile:
+    """Per-instrumented-function state: the compiled-shape registry plus
+    call/compile/retrace tallies and (opt-in) cost-analysis entries.
+    Mutated under the module lock."""
+
+    __slots__ = ("name", "calls", "compiles", "retraces", "shapes", "costs")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.compiles = 0
+        self.retraces = 0
+        #: signature -> call count; signature order == first-seen order
+        self.shapes: Dict[Tuple, int] = {}
+        #: signature -> {"flops", "bytes_accessed", "hbm_peak_bytes", ...}
+        self.costs: Dict[Tuple, dict] = {}
+
+    def block_shapes(self):
+        """The leading array shape of each seen signature (tests use this
+        to pin the "at most 2-3 compiled shapes per axis" claim)."""
+        out = []
+        for sig in self.shapes:
+            for entry in sig:
+                if entry[0] == "a":
+                    out.append(entry[1])
+                    break
+        return out
+
+    def totals(self) -> dict:
+        """Cost totals across every call (per-signature cost x calls)."""
+        flops = bytes_acc = 0.0
+        hbm_peak = 0
+        for sig, cost in self.costs.items():
+            n = self.shapes.get(sig, 0)
+            flops += n * float(cost.get("flops") or 0.0)
+            bytes_acc += n * float(cost.get("bytes_accessed") or 0.0)
+            hbm_peak = max(hbm_peak, int(cost.get("hbm_peak_bytes") or 0))
+        return {"flops": flops, "bytes_accessed": bytes_acc,
+                "hbm_peak_bytes": hbm_peak}
+
+    def to_obj(self) -> dict:
+        return {
+            "calls": self.calls,
+            "compiles": self.compiles,
+            "retraces": self.retraces,
+            "compiled_shapes": len(self.shapes),
+            "block_shapes": [list(s) for s in self.block_shapes()],
+        }
+
+
+def _sig_entry(value, out) -> None:
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is not None and dtype is not None:
+        out.append(("a", tuple(shape), str(dtype)))
+        return
+    if isinstance(value, (tuple, list)):  # pytree containers, by structure
+        out.append(("[", len(value)))
+        for item in value:
+            _sig_entry(item, out)
+        return
+    if isinstance(value, dict):
+        out.append(("{", len(value)))
+        for key in sorted(value, key=str):
+            out.append(("k", str(key)))
+            _sig_entry(value[key], out)
+        return
+    try:
+        hash(value)
+        out.append(("s", value))
+    except TypeError:
+        # unhashable non-container leaf: record the TYPE only — embedding
+        # repr(value) would make every distinct VALUE a distinct
+        # "compiled shape" (unbounded registry growth, one spurious AOT
+        # cost-compile per call, parameter dumps in span events)
+        out.append(("t", type(value).__name__))
+
+
+def _signature(args, kwargs) -> Tuple:
+    """Hashable trace signature of a call: array leaves by (shape, dtype)
+    — pytree containers (tuples/lists/dicts, e.g. a trainer's params and
+    optimizer state) are flattened structurally — and static values
+    (scheme params etc.) by value. Mirrors what makes jax.jit retrace,
+    which is the whole point of the registry."""
+    entries = []
+    items = list(enumerate(args)) + sorted(
+        kwargs.items(), key=lambda kv: str(kv[0]))
+    for _key, value in items:
+        _sig_entry(value, entries)
+    return tuple(entries)
+
+
+def _is_traced(args, kwargs) -> bool:
+    """True when the call happens INSIDE an outer trace (arguments are
+    jax Tracers): the inner jit inlines into the enclosing program, so
+    counting it as a device dispatch — or trying to lower it — would be
+    wrong; only the named_scope annotation applies."""
+    try:
+        from jax.core import Tracer
+    except Exception:
+        try:  # newer jax moved the public alias
+            from jax._src.core import Tracer
+        except Exception:
+            return False
+    return any(isinstance(v, Tracer) for v in args) \
+        or any(isinstance(v, Tracer) for v in kwargs.values())
+
+
+def _cache_size(fn) -> Optional[int]:
+    getter = getattr(fn, "_cache_size", None)
+    if getter is None:
+        return None
+    try:
+        return int(getter())
+    except Exception:
+        return None
+
+
+def _normalize_cost(analysis) -> dict:
+    """``Compiled.cost_analysis()`` returns a dict on current jax and a
+    list of per-computation dicts on older releases; fold either into
+    {"flops", "bytes_accessed"}."""
+    out = {"flops": 0.0, "bytes_accessed": 0.0}
+    if analysis is None:
+        return out
+    parts = analysis if isinstance(analysis, (list, tuple)) else [analysis]
+    for part in parts:
+        if not isinstance(part, dict):
+            continue
+        out["flops"] += float(part.get("flops") or 0.0)
+        out["bytes_accessed"] += float(part.get("bytes accessed") or 0.0)
+    return out
+
+
+def _normalize_memory(stats) -> dict:
+    """``Compiled.memory_analysis()`` -> byte-level footprint; the peak-HBM
+    estimate is arguments + outputs + temps + generated code (the standard
+    XLA live-set upper bound for one executable)."""
+    if stats is None:
+        return {}
+    fields = {
+        "argument_bytes": "argument_size_in_bytes",
+        "output_bytes": "output_size_in_bytes",
+        "temp_bytes": "temp_size_in_bytes",
+        "generated_code_bytes": "generated_code_size_in_bytes",
+        "alias_bytes": "alias_size_in_bytes",
+    }
+    out = {}
+    for key, attr in fields.items():
+        value = getattr(stats, attr, None)
+        if value is not None:
+            out[key] = int(value)
+    out["hbm_peak_bytes"] = (
+        out.get("argument_bytes", 0) + out.get("output_bytes", 0)
+        + out.get("temp_bytes", 0) + out.get("generated_code_bytes", 0)
+        - out.get("alias_bytes", 0)
+    )
+    return out
+
+
+def enable_cost_analysis(on: bool = True) -> None:
+    """Opt in to per-shape cost/memory analysis (one extra ahead-of-time
+    compile per new signature — bench/sim entry points only; library and
+    test runs keep compiles single). SDA_DEVPROF_COST=0/1 overrides."""
+    global _cost_enabled
+    _cost_enabled = bool(on)
+
+
+def cost_analysis_enabled() -> bool:
+    env = os.environ.get("SDA_DEVPROF_COST")
+    if env is not None and env != "":
+        return env not in ("0", "false", "no")
+    return _cost_enabled
+
+
+# -- jax.monitoring taps ------------------------------------------------------
+
+def _on_event_duration(event: str, duration_s: float, **_kw) -> None:
+    if event == "/jax/core/compile/backend_compile_duration":
+        metrics.count("xla.compile.backend")
+        metrics.observe("xla.compile.seconds", duration_s)
+
+
+def _on_event(event: str, **_kw) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        metrics.count("xla.compile.cache.hit")
+    elif event == "/jax/compilation_cache/cache_misses":
+        metrics.count("xla.compile.cache.miss")
+
+
+def install_monitoring() -> bool:
+    """Register the ``jax.monitoring`` listeners feeding the process-wide
+    ``xla.compile.*`` counters and the compile-seconds histogram.
+    Idempotent; listeners stay for the process lifetime (jax offers no
+    per-listener removal) and write only into the metrics registry, which
+    ``obs.reset_all()`` clears. Returns False when jax is unavailable."""
+    global _monitoring_installed
+    with _lock:
+        if _monitoring_installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:  # no jax in this profile — devprof stays inert
+            return False
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        monitoring.register_event_listener(_on_event)
+        _monitoring_installed = True
+        return True
+
+
+# -- the instrument wrapper ---------------------------------------------------
+
+def profile(name: str) -> FnProfile:
+    """The (created-on-demand) profile entry for ``name``."""
+    with _lock:
+        prof = _profiles.get(name)
+        if prof is None:
+            prof = _profiles[name] = FnProfile(name)
+        return prof
+
+
+def _capture_cost(prof: FnProfile, fn, sig: Tuple, args, kwargs) -> None:
+    """AOT lower+compile for cost/memory analysis, BEFORE the real call so
+    donated argument buffers are still alive. Any surprise is recorded,
+    never raised — profiling must not fail the round it observes."""
+    try:
+        import warnings
+
+        with warnings.catch_warnings():
+            # the AOT compile never executes, so jax warns that donated
+            # buffers went unused — noise for a cost-only compile
+            warnings.filterwarnings(
+                "ignore", message=".*donated buffers.*")
+            compiled = fn.lower(*args, **kwargs).compile()
+        entry = _normalize_cost(compiled.cost_analysis())
+        entry.update(_normalize_memory(compiled.memory_analysis()))
+    except Exception as e:  # noqa: BLE001 — observability stays best-effort
+        entry = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    with _lock:
+        prof.costs[sig] = entry
+    peak = entry.get("hbm_peak_bytes")
+    if peak:
+        metrics.gauge_max("device.hbm.peak_bytes", peak)
+        metrics.gauge_max(f"device.hbm.peak_bytes.{prof.name}", peak)
+
+
+def _record_retrace(name: str, sig: Tuple, compiles: int) -> None:
+    metrics.count("xla.compile.retrace")
+    metrics.count(f"xla.compile.retrace.{name}")
+    attrs = {"function": name, "signature": str(sig),
+             "compiles_before": compiles}
+    if _trace.current_span() is not None:
+        _trace.add_event("xla.retrace", **attrs)
+    else:
+        # no open span (bare library call): a zero-length marker span keeps
+        # the event exportable instead of silently dropping it
+        with _trace.span("xla.retrace", attributes={"function": name}):
+            _trace.add_event("xla.retrace", **attrs)
+
+
+def instrument(name: str, fn):
+    """Wrap a jitted callable with the compiled-shape registry.
+
+    Repeated ``instrument`` calls with the same ``name`` (e.g. the
+    streaming driver building one step per block shape) accumulate into
+    ONE profile entry, so the registry reflects the logical phase, not
+    the python object. The wrapper forwards ``lower``/``_cache_size`` so
+    AOT consumers and the jit-cache tripwire tests keep working.
+    """
+    profile(name)  # eager registration; the wrapper re-resolves per call
+    # compile accounting and cost capture only make sense for jit-like
+    # callables; a plain eager function wrapped for counters must not
+    # fabricate "compiles"/"retraces" per new argument shape
+    jitlike = hasattr(fn, "lower") or _cache_size(fn) is not None
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _is_traced(args, kwargs):
+            import jax
+
+            with jax.named_scope(name):
+                return fn(*args, **kwargs)
+        # re-resolved per call, NOT closed over: module-level wrappers
+        # (fields/sharing.py) outlive obs.reset_all(), and stats written
+        # into a pre-reset profile object would be invisible forever
+        prof = profile(name)
+        sig = _signature(args, kwargs)
+        before = _cache_size(fn)
+        with _lock:
+            prof.calls += 1
+            new_sig = sig not in prof.shapes
+            prof.shapes[sig] = prof.shapes.get(sig, 0) + 1
+        will_compile = (new_sig and jitlike) if before is None else None
+        if new_sig and jitlike and cost_analysis_enabled():
+            _capture_cost(prof, fn, sig, args, kwargs)
+        try:
+            import jax
+
+            with jax.named_scope(name):
+                out = fn(*args, **kwargs)
+        except ImportError:  # pragma: no cover — jax-free profiles
+            out = fn(*args, **kwargs)
+        if will_compile is None:
+            after = _cache_size(fn)
+            will_compile = after is not None and before is not None \
+                and after > before
+        if will_compile:
+            # account at COMPLETION time, under the lock: two threads
+            # racing the function's first two compiles must still record
+            # the second one as a retrace
+            with _lock:
+                compiles_before = prof.compiles
+                prof.compiles += 1
+                if compiles_before >= 1:
+                    prof.retraces += 1
+            metrics.count("xla.compile.fn")
+            metrics.count(f"xla.compile.fn.{name}")
+            if compiles_before >= 1:
+                _record_retrace(name, sig, compiles_before)
+        return out
+
+    wrapper.__wrapped__ = fn
+    for attr in ("lower", "_cache_size", "trace", "eval_shape"):
+        value = getattr(fn, attr, None)
+        if value is not None:
+            setattr(wrapper, attr, value)
+    return wrapper
+
+
+# -- reports ------------------------------------------------------------------
+
+def report() -> Dict[str, dict]:
+    """{function name: compile/shape/retrace summary} for every
+    instrumented function CALLED since the last reset (instrument()
+    registers profiles eagerly at import; zero-call entries are noise)."""
+    with _lock:
+        return {name: prof.to_obj()
+                for name, prof in sorted(_profiles.items())
+                if prof.calls or prof.compiles}
+
+
+def compile_totals() -> dict:
+    """The compile-telemetry summary (statusz / bench ``xla`` block):
+    per-function registry, process-wide backend-compile counter + seconds
+    histogram, persistent-cache hit/miss counters."""
+    counters = metrics.counter_report("xla.compile.")
+    hist = metrics.histogram_report("xla.compile.seconds").get(
+        "xla.compile.seconds")
+    return {
+        "functions": report(),
+        "backend_compiles": counters.get("xla.compile.backend", 0),
+        "retraces": counters.get("xla.compile.retrace", 0),
+        "compile_seconds": hist,
+        "cache": {
+            "hit": counters.get("xla.compile.cache.hit", 0),
+            "miss": counters.get("xla.compile.cache.miss", 0),
+        },
+    }
+
+
+def _peaks(platform: Optional[str]) -> Tuple[str, dict]:
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception:
+            platform = "cpu"
+    if platform == "cpu":
+        label, peaks = "cpu", dict(CHIP_PEAKS["cpu"])
+    elif platform in ("tpu", "axon"):
+        label, peaks = "tpu", dict(CHIP_PEAKS["tpu"])
+    else:
+        # a platform with no pinned peaks (gpu etc.) must not be scored
+        # against — or labeled as — the TPU roofline
+        label, peaks = platform, dict(CHIP_PEAKS["cpu"])
+        peaks["source"] = (f"no pinned peaks for platform {platform!r} — "
+                           f"nominal placeholders, override via env")
+    for env, key in (("SDA_ROOFLINE_PEAK_FLOPS", "flops_per_s"),
+                     ("SDA_ROOFLINE_PEAK_BW", "hbm_bytes_per_s")):
+        raw = os.environ.get(env)
+        if raw:
+            try:
+                peaks[key] = float(raw)
+                peaks["source"] = "env override"
+            except ValueError:
+                pass
+    return label, peaks
+
+
+def roofline_block(flops: float, bytes_accessed: float,
+                   seconds: Optional[float] = None,
+                   platform: Optional[str] = None,
+                   hbm_peak_bytes: int = 0) -> dict:
+    """The bench-JSON ``roofline`` block for explicit totals: arithmetic
+    intensity, attainable rate under the chip peaks (``min(peak_flops,
+    AI x peak_bw)``), and achieved utilization when ``seconds`` is given."""
+    family, peaks = _peaks(platform)
+    ai = flops / bytes_accessed if bytes_accessed else 0.0
+    attainable = min(peaks["flops_per_s"], ai * peaks["hbm_bytes_per_s"]) \
+        if ai else peaks["flops_per_s"]
+    block = {
+        "platform": family,
+        "peaks": peaks,
+        "flops": flops,
+        "bytes": bytes_accessed,
+        "arithmetic_intensity": round(ai, 4),
+        "attainable_flops_per_s": attainable,
+        "hbm_peak_bytes": int(hbm_peak_bytes),
+    }
+    if seconds and seconds > 0:
+        achieved = flops / seconds
+        block["seconds"] = round(seconds, 6)
+        block["achieved_flops_per_s"] = achieved
+        # significant digits, not decimal places: a CPU fallback sits many
+        # orders below the tpu roofline and must not round to zero
+        block["utilization"] = float(f"{achieved / attainable:.4g}") \
+            if attainable else 0.0
+    return block
+
+
+def roofline(seconds: Optional[float] = None, names=None,
+             platform: Optional[str] = None, basis: str = "total") -> dict:
+    """Fold the recorded cost entries into one ``roofline`` block.
+
+    ``basis="total"`` sums cost x calls over every signature (pair with
+    the wall-clock of the whole measured region, e.g. sda-sim);
+    ``basis="per_call"`` takes one call's worth per function (pair with a
+    marginal per-round time, e.g. bench.py). ``names`` filters which
+    instrumented functions contribute (default: all with cost data).
+    """
+    with _lock:
+        profs = [p for n, p in sorted(_profiles.items())
+                 if (names is None or n in names) and p.costs]
+    flops = bytes_acc = 0.0
+    hbm_peak = 0
+    phases = {}
+    for prof in profs:
+        totals = prof.totals()
+        if basis == "per_call":
+            last_sig = next(reversed(prof.costs))
+            cost = prof.costs[last_sig]
+            f = float(cost.get("flops") or 0.0)
+            b = float(cost.get("bytes_accessed") or 0.0)
+        else:
+            f, b = totals["flops"], totals["bytes_accessed"]
+        flops += f
+        bytes_acc += b
+        hbm_peak = max(hbm_peak, totals["hbm_peak_bytes"])
+        phases[prof.name] = {
+            "calls": prof.calls,
+            "flops": f,
+            "bytes": b,
+            "arithmetic_intensity": round(f / b, 4) if b else 0.0,
+            "hbm_peak_bytes": totals["hbm_peak_bytes"],
+        }
+    block = roofline_block(flops, bytes_acc, seconds=seconds,
+                           platform=platform, hbm_peak_bytes=hbm_peak)
+    block["basis"] = basis
+    block["phases"] = phases
+    return block
+
+
+def reset() -> None:
+    """Clear the compiled-shape registry and cost entries (the
+    ``xla.compile.*`` counters and HBM gauges live in the metrics
+    registry, which ``obs.reset_all()`` clears alongside this)."""
+    with _lock:
+        _profiles.clear()

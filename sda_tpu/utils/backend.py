@@ -129,6 +129,16 @@ def enable_compile_cache(platform: str = "axon",
         jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
     except (AttributeError, ValueError):
         pass
+    # devprof taps jax.monitoring for the cache's hit/miss events
+    # (xla.compile.cache.*) plus per-compile seconds — armed together with
+    # the cache so every bench entry point reports whether a window
+    # actually skipped its compiles
+    try:
+        from ..obs import devprof
+
+        devprof.install_monitoring()
+    except Exception as e:
+        log(f"devprof monitoring unavailable: {type(e).__name__}: {e}")
     return path
 
 

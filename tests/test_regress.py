@@ -1,0 +1,168 @@
+"""Regression-gate contract (``sda_tpu.obs.regress`` / ``sda-bench``).
+
+Golden fixtures in ``tests/fixtures/regress/`` cover the four scenarios
+the gate must get right: a clean pass, a confirmed regression (synthetic
+2x slowdown), a noisy-but-within-threshold record, and the honest
+error-record bench line (skipped, never flagged). The committed repo
+trajectory BENCH_r01-r05 itself must gate green — that is the
+acceptance bar every future perf PR inherits.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from sda_tpu.obs import regress
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "regress")
+
+
+def _fx(*names):
+    return [os.path.join(FIXTURES, n) for n in names]
+
+
+def _history(*extra):
+    base = [f"BENCH_r{n:02d}.json" for n in range(1, 6)]
+    return _fx(*base, *extra)
+
+
+# -- scenarios ---------------------------------------------------------------
+
+def test_clean_trajectory_passes():
+    assert regress.main(_history()) == 0
+    result = regress.check(regress.load_records(_history()))
+    assert result["checked"]
+    assert result["regressions"] == []
+    # r01 (no parsed measurement) skipped, not flagged
+    assert any("r01" in s["path"] for s in result["skipped"])
+
+
+def test_clean_continuation_passes():
+    assert regress.main(_history("BENCH_r06_clean.json")) == 0
+
+
+def test_synthetic_2x_slowdown_is_confirmed_regression():
+    paths = _history("BENCH_r06_regression.json")
+    assert regress.main(paths) == 1
+    result = regress.check(regress.load_records(paths))
+    assert "value" in result["regressions"]
+    assert "round_seconds_marginal" in result["regressions"]
+    by_metric = {r["metric"]: r for r in result["rows"]}
+    assert by_metric["value"]["verdict"] == "REGRESSION"
+    # compile_seconds stays advisory: never gates the exit code
+    assert not by_metric.get("compile_seconds", {"gates": False})["gates"]
+
+
+def test_noisy_within_threshold_passes():
+    paths = _history("BENCH_r06_noisy.json")
+    assert regress.main(paths) == 0
+    result = regress.check(regress.load_records(paths))
+    row = {r["metric"]: r for r in result["rows"]}["value"]
+    # the deficit is real and visible, but inside the noise threshold
+    assert row["delta"] < -0.10
+    assert row["verdict"].startswith("pass")
+
+
+def test_error_record_as_newest_is_skipped_not_flagged():
+    paths = _history("BENCH_r06_error.json")
+    assert regress.main(paths) == 0
+    result = regress.check(regress.load_records(paths))
+    assert any("r06_error" in s["path"] for s in result["skipped"])
+    # the gate falls back to the newest REAL record (r05)
+    assert result["newest"].endswith("BENCH_r05.json")
+    assert result["regressions"] == []
+
+
+def test_advisory_mode_reports_but_exits_zero(capsys):
+    paths = _history("BENCH_r06_regression.json")
+    assert regress.main(paths + ["--advisory"]) == 0
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_malformed_record_exits_2(tmp_path):
+    bad = tmp_path / "BENCH_r99.json"
+    bad.write_text("this is not json")
+    assert regress.main(_history() + [str(bad)]) == 2
+    shapeless = tmp_path / "BENCH_r98.json"
+    shapeless.write_text(json.dumps({"hello": "world"}))
+    assert regress.main(_history() + [str(shapeless)]) == 2
+
+
+def test_platform_mismatch_is_not_compared(tmp_path):
+    # a TPU record following CPU history has no comparable window: the
+    # 3-orders CPU/chip gap must never read as a 1000x "improvement",
+    # nor a later CPU fallback as a 1000x regression
+    rec = json.load(open(_fx("BENCH_r05.json")[0]))
+    rec["n"] = 6
+    rec["parsed"]["platform"] = "tpu"
+    rec["parsed"]["value"] = rec["parsed"]["value"] * 700
+    path = tmp_path / "BENCH_r06.json"
+    path.write_text(json.dumps(rec))
+    result = regress.check(regress.load_records(_history() + [str(path)]))
+    assert not result["checked"]
+    assert "insufficient comparable history" in result["note"]
+
+
+def test_json_output_mode(capsys):
+    assert regress.main(_history() + ["--json"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed["checked"] and parsed["regressions"] == []
+
+
+def test_raw_bench_line_appended_after_wrappers_is_gated(tmp_path):
+    # a fresh RAW bench line (no driver wrapper) appended after the
+    # committed wrapper trajectory must be treated as the NEWEST record
+    # and gated — not lose a seq tiebreak and silently become history
+    slow = json.load(open(_fx("BENCH_r06_regression.json")[0]))["parsed"]
+    path = tmp_path / "fresh_run.json"
+    path.write_text(json.dumps(slow))
+    paths = _history() + [str(path)]
+    result = regress.check(regress.load_records(paths))
+    assert result["newest"] == str(path)
+    assert "value" in result["regressions"]
+    assert regress.main(paths) == 1
+
+
+# -- the committed repo trajectory itself ------------------------------------
+
+def test_committed_bench_trajectory_gates_green():
+    committed = sorted(glob.glob(os.path.join(regress.repo_root(),
+                                              "BENCH_r*.json")))
+    if len(committed) < 3:
+        pytest.skip("repo has no committed bench trajectory")
+    assert regress.main(committed) == 0
+
+
+def test_committed_trajectory_with_synthetic_2x_slowdown_fails(tmp_path):
+    committed = sorted(glob.glob(os.path.join(regress.repo_root(),
+                                              "BENCH_r*.json")))
+    if len(committed) < 3:
+        pytest.skip("repo has no committed bench trajectory")
+    records = regress.load_records(committed)
+    newest = next(e for e in reversed(records) if e["record"] is not None)
+    slow = {"n": 99, "cmd": "synthetic", "rc": 0, "tail": "",
+            "parsed": dict(newest["record"])}
+    slow["parsed"]["value"] = newest["record"]["value"] / 2
+    if isinstance(newest["record"].get("round_seconds_marginal"),
+                  (int, float)):
+        slow["parsed"]["round_seconds_marginal"] = \
+            newest["record"]["round_seconds_marginal"] * 2
+    path = tmp_path / "BENCH_r99.json"
+    path.write_text(json.dumps(slow))
+    assert regress.main(committed + [str(path)]) == 1
+
+
+# -- the sda-bench front-end -------------------------------------------------
+
+def test_sda_bench_check_forwards_to_regress():
+    from sda_tpu.cli import bench as sda_bench
+
+    assert sda_bench.main(["--check", *_history()]) == 0
+    assert sda_bench.main(
+        ["--check", *_history("BENCH_r06_regression.json")]) == 1
+    assert sda_bench.main(
+        ["--check", "--advisory",
+         *_history("BENCH_r06_regression.json")]) == 0
