@@ -27,6 +27,32 @@ print(f"loadgen smoke OK: {report['load_requests']} load-phase requests, "
       f"{report['sustained_rps']} rps sustained")
 PY
 
+echo "== wire codec A/B (fixed seed: same round JSON vs binary, bit-exact both ways)"
+CODEC_JSON=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 16 --dim 64 \
+  --load-arrivals closed --load-concurrency 4 --load-seed 20260803 \
+  --load-store memory --load-codec json)
+CODEC_BIN=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 16 --dim 64 \
+  --load-arrivals closed --load-concurrency 4 --load-seed 20260803 \
+  --load-store memory --load-codec bin)
+CODEC_JSON="$CODEC_JSON" CODEC_BIN="$CODEC_BIN" python - <<'PY'
+import json, os
+reports = {}
+for codec in ("json", "bin"):
+    report = json.loads(os.environ[f"CODEC_{codec.upper()}"].strip().splitlines()[-1])
+    # the wire codec must never change the round's outcome
+    assert report["ready"] and report["exact"], (codec, report)
+    assert report["client_failures"] == 0, (codec, report)
+    assert report["codec"] == codec, (codec, report["codec"])
+    reports[codec] = report
+counters = {c: reports[c].get("codec_counters") or {} for c in reports}
+# the bin swarm actually spoke binary; the json swarm never did
+assert counters["bin"].get("http.codec.bin.in", 0) > 0, counters["bin"]
+assert counters["json"].get("http.codec.bin.in", 0) == 0, counters["json"]
+for codec, report in reports.items():
+    print(f"codec {codec}: exact={report['exact']} "
+          f"rps={report['sustained_rps']} counters={counters[codec]}")
+PY
+
 echo "== trace smoke (fixed seed: Chrome-trace export, one connected round trace, bit-exact)"
 TRACE_OUT=$(mktemp /tmp/sda-trace-XXXX.json)
 TRACE_REPORT=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 12 --dim 4 \

@@ -89,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--load-chaos-rate", type=float, default=0.0,
                         help="combined load+chaos drill: also 500 this "
                              "fraction of requests (--load)")
+    parser.add_argument("--load-codec", choices=["auto", "json", "bin"],
+                        default="auto",
+                        help="wire codec for the swarm: auto (negotiate "
+                             "application/x-sda-bin via the server advert), "
+                             "json (legacy wire pinned), bin (forced "
+                             "binary) (--load)")
     parser.add_argument("--chaos", action="store_true",
                         help="robustness profile: run a full federated "
                              "round over real HTTP with deterministic "
@@ -257,6 +263,7 @@ def _run_load(args) -> int:
             rate_limit=rate,
             rate_burst=4.0 if burst is None else burst,
             chaos_rate=chaos_rate,
+            codec=args.load_codec,
         ))
     _export_trace(args, report)
     print(json.dumps(report))

@@ -9,13 +9,17 @@ Participating/Clerking/Receiving/Maintenance traits).
 
 from __future__ import annotations
 
+import os
+import threading
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from .. import obs
 from ..crypto import CryptoModule, Keystore, signature_is_valid
-from ..utils import timed_phase
+from ..crypto import batch as crypto_batch
+from ..utils import metrics, timed_phase
 from ..protocol import (
     Agent,
     AgentId,
@@ -36,6 +40,12 @@ from ..protocol import (
 )
 
 
+#: Largest modulus whose residues are exactly representable in int64 —
+#: below this the reveal path stays in numpy end-to-end (no Python-int
+#: materialization); above it the arbitrary-precision object lane engages.
+_INT64_MAX = (1 << 63) - 1
+
+
 class RecipientOutput:
     """Revealed aggregate (receive.rs:7-21).
 
@@ -49,14 +59,25 @@ class RecipientOutput:
     __slots__ = ("modulus", "values", "participations")
 
     def __init__(self, modulus: int, values, participations=None):
-        self.modulus = modulus
-        self.values = np.asarray(values, dtype=np.int64)
+        self.modulus = int(modulus)
+        if self.modulus <= _INT64_MAX:
+            # int64 lane: every residue fits, stay vectorized end-to-end
+            self.values = np.asarray(values, dtype=np.int64)
+        else:
+            # arbitrary-precision lane: object dtype instead of a silent
+            # int64 wrap (np.mod stays elementwise-correct on object arrays)
+            self.values = np.asarray(
+                [int(v) for v in np.asarray(values, dtype=object).ravel()],
+                dtype=object,
+            )
         self.participations = (None if participations is None
                                else int(participations))
 
     def positive(self) -> "RecipientOutput":
-        """Lift representatives into [0, modulus) — kept for API parity;
-        this implementation is canonical already (receive.rs:14-21)."""
+        """Lift representatives into [0, modulus) (receive.rs:14-21).
+        ``np.mod`` serves both lanes: one vectorized pass for int64
+        moduli, elementwise bigint arithmetic on the object lane — no
+        intermediate Python list either way."""
         return RecipientOutput(self.modulus, np.mod(self.values, self.modulus),
                                self.participations)
 
@@ -77,11 +98,95 @@ def _committee_key_variant(aggregation: Aggregation) -> str:
     )
 
 
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
 class SdaClient:
     def __init__(self, agent: Agent, keystore: Keystore, service: SdaService):
         self.agent = agent
         self.crypto = CryptoModule(keystore)
         self.service = service
+        # immutable-document cache, keyed by aggregation id: the
+        # aggregation resource, its committee, and signature-VERIFIED
+        # encryption keys. All three are write-once per aggregation in the
+        # protocol (the committee is elected exactly once, keys are
+        # content-addressed by id), so a polling clerk must not re-fetch —
+        # and re-verify — them on every job. Invalidated on the round
+        # boundaries this client drives (upload/begin/end/snapshot);
+        # SDA_CLIENT_CACHE=0 disables caching entirely.
+        self._doc_cache: dict = {}
+        self._doc_cache_lock = threading.Lock()
+
+    # -- immutable-document cache --------------------------------------
+    @staticmethod
+    def _cache_enabled() -> bool:
+        return os.environ.get("SDA_CLIENT_CACHE", "1") != "0"
+
+    def _cache_entry(self, aggregation_id: AggregationId) -> dict:
+        # locked: the clerk pipeline touches the cache from pool threads
+        # (fetch_committee/fetch_recipient_key) concurrently with the main
+        # thread, and eviction must not race entry creation
+        with self._doc_cache_lock:
+            entry = self._doc_cache.get(aggregation_id)
+            if entry is None:
+                # bounded: a long-lived clerk serves many aggregations but
+                # only the recipient path ever invalidates, so evict the
+                # least-recently-created entries past SDA_CLIENT_CACHE_MAX
+                # (aggregations are short-lived relative to a polling clerk)
+                limit = max(1, _env_int("SDA_CLIENT_CACHE_MAX", 64))
+                while len(self._doc_cache) >= limit:
+                    self._doc_cache.pop(next(iter(self._doc_cache)))
+                entry = self._doc_cache[aggregation_id] = {"keys": {}}
+            return entry
+
+    def _invalidate(self, aggregation_id: AggregationId) -> None:
+        with self._doc_cache_lock:
+            self._doc_cache.pop(aggregation_id, None)
+
+    def _cached_aggregation(self, aggregation_id) -> Optional[Aggregation]:
+        if not self._cache_enabled():
+            return self.service.get_aggregation(self.agent, aggregation_id)
+        entry = self._cache_entry(aggregation_id)
+        aggregation = entry.get("aggregation")
+        if aggregation is None:
+            aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+            if aggregation is not None:
+                entry["aggregation"] = aggregation
+        return aggregation
+
+    def _cached_committee(self, aggregation_id) -> Optional[Committee]:
+        if not self._cache_enabled():
+            return self.service.get_committee(self.agent, aggregation_id)
+        entry = self._cache_entry(aggregation_id)
+        committee = entry.get("committee")
+        if committee is None:
+            committee = self.service.get_committee(self.agent, aggregation_id)
+            if committee is not None:
+                entry["committee"] = committee
+        return committee
+
+    def _cached_verified_key(self, aggregation_id, owner_id: AgentId,
+                             key_id: EncryptionKeyId):
+        """``_fetch_verified_key`` behind the per-aggregation cache: the
+        fetch AND the signature verification happen once per (owner, key)
+        pair — keying on the owner too preserves the owner binding the
+        signature check enforces (a key id listed under a different agent
+        must still fail verification, cached or not)."""
+        if not self._cache_enabled():
+            return self._fetch_verified_key(owner_id, key_id)
+        keys = self._cache_entry(aggregation_id)["keys"]
+        key = keys.get((owner_id, key_id))
+        if key is None:
+            key = self._fetch_verified_key(owner_id, key_id)
+            keys[(owner_id, key_id)] = key
+        return key
 
     @classmethod
     def new_agent(cls, keystore: Keystore) -> Agent:
@@ -139,13 +244,13 @@ class SdaClient:
         """
         secrets = np.asarray(input, dtype=np.int64)
 
-        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        aggregation = self._cached_aggregation(aggregation_id)
         if aggregation is None:
             raise NotFound("could not find aggregation")
         if secrets.shape != (aggregation.vector_dimension,):
             raise ValueError("the input length does not match the aggregation")
 
-        committee = self.service.get_committee(self.agent, aggregation_id)
+        committee = self._cached_committee(aggregation_id)
         if committee is None:
             raise NotFound("could not find committee")
 
@@ -156,8 +261,8 @@ class SdaClient:
 
         recipient_encryption = None
         if len(recipient_mask) > 0:
-            recipient_key = self._fetch_verified_key(
-                aggregation.recipient, aggregation.recipient_key
+            recipient_key = self._cached_verified_key(
+                aggregation_id, aggregation.recipient, aggregation.recipient_key
             )
             encryptor = self.crypto.new_share_encryptor(
                 recipient_key, aggregation.recipient_encryption_scheme
@@ -169,16 +274,28 @@ class SdaClient:
         with timed_phase("participant.share"):
             shares_per_clerk = generator.generate(masked_secrets)
 
-        clerk_encryptions = []
         with timed_phase("participant.encrypt"):
-            for (clerk_id, clerk_key_id), clerk_shares in zip(
-                committee.clerks_and_keys, shares_per_clerk
-            ):
-                clerk_key = self._fetch_verified_key(clerk_id, clerk_key_id)
-                encryptor = self.crypto.new_share_encryptor(
-                    clerk_key, aggregation.committee_encryption_scheme
-                )
-                clerk_encryptions.append((clerk_id, encryptor.encrypt(clerk_shares)))
+            # one fetch-verify-seal task per clerk, fanned out on the
+            # bounded crypto pool (libsodium drops the GIL; HTTP key
+            # fetches overlap too). ``parent`` pins worker-thread spans to
+            # this round's trace — pool threads have no ambient context.
+            ctx = obs.current_context()
+
+            def seal_for_clerk(pair):
+                (clerk_id, clerk_key_id), clerk_shares = pair
+                with obs.span("participant.seal", parent=ctx,
+                              attributes={"clerk": str(clerk_id)}):
+                    clerk_key = self._cached_verified_key(
+                        aggregation_id, clerk_id, clerk_key_id)
+                    encryptor = self.crypto.new_share_encryptor(
+                        clerk_key, aggregation.committee_encryption_scheme
+                    )
+                    return (clerk_id, encryptor.encrypt(clerk_shares))
+
+            clerk_encryptions = crypto_batch.pmap(
+                seal_for_clerk,
+                list(zip(committee.clerks_and_keys, shares_per_clerk)),
+            )
 
         return Participation(
             id=ParticipationId.random(),
@@ -246,8 +363,12 @@ class SdaClient:
             if chaos.evaluate("clerk.abandon_job", kinds=("drop",)) is not None:
                 job_span.set_attribute("abandoned", True)
                 return False
+            t0 = time.perf_counter()
             result = self.process_clerking_job(job)
             self.service.create_clerking_result(self.agent, result)
+            # job wall time (process + result upload): the loadgen capacity
+            # report surfaces this histogram as ``clerk_job_ms``
+            metrics.observe("clerk.job.seconds", time.perf_counter() - t0)
         return True
 
     def run_chores(self, max_iterations: int = -1) -> None:
@@ -260,11 +381,29 @@ class SdaClient:
 
     def process_clerking_job(self, job: ClerkingJob) -> ClerkingResult:
         """Decrypt shares -> modular sum -> re-encrypt to recipient
-        (clerk.rs:63-107) — the clerk hot path."""
-        aggregation = self.service.get_aggregation(self.agent, job.aggregation)
+        (clerk.rs:63-107) — the clerk hot path.
+
+        Pipelined: encryptions are decrypted in ``SDA_CLERK_BATCH``-sized
+        bundles on the bounded crypto pool (libsodium releases the GIL)
+        and each decrypted bundle feeds ONE stacked ``[B, dim]`` combine
+        call; the pool keeps the next bundle's decryption in flight while
+        the current bundle is being combined on the device
+        (double-buffered — ``crypto.batch.prefetch_map``). Partial sums
+        fold modularly, so the result is bit-exact with the scalar path.
+        """
+        # the committee fetch rides the pool so its round trip overlaps
+        # the aggregation fetch (both immutable-doc-cached, independent)
+        ctx = obs.current_context()
+
+        def fetch_committee():
+            with obs.span("clerk.fetch_committee", parent=ctx):
+                return self._cached_committee(job.aggregation)
+
+        committee_handle = crypto_batch.submit(fetch_committee)
+        aggregation = self._cached_aggregation(job.aggregation)
         if aggregation is None:
             raise NotFound("unknown aggregation")
-        committee = self.service.get_committee(self.agent, job.aggregation)
+        committee = committee_handle.result()
         if committee is None:
             raise NotFound("unknown committee")
 
@@ -278,16 +417,43 @@ class SdaClient:
         decryptor = self.crypto.new_share_decryptor(
             own_key_id, aggregation.committee_encryption_scheme
         )
-        with timed_phase("clerk.decrypt"):
-            share_vectors = [decryptor.decrypt(e) for e in job.encryptions]
-
         combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
-        with timed_phase("clerk.combine"):
-            combined = combiner.combine(share_vectors)
 
-        recipient_key = self._fetch_verified_key(
-            aggregation.recipient, aggregation.recipient_key
-        )
+        # the recipient key is only needed AFTER the last combine: fetch
+        # and signature-verify it on the pool while the pipeline decrypts
+        def fetch_recipient_key():
+            with obs.span("clerk.fetch_recipient_key", parent=ctx):
+                return self._cached_verified_key(
+                    job.aggregation, aggregation.recipient,
+                    aggregation.recipient_key)
+
+        recipient_key_handle = crypto_batch.submit(fetch_recipient_key)
+
+        batch_size = max(1, _env_int("SDA_CLERK_BATCH", 256))
+        combined = None
+        with obs.span("clerk.pipeline", attributes={
+            "participations": len(job.encryptions),
+            "batch_size": batch_size,
+            "workers": crypto_batch.worker_count(),
+        }):
+            batches = crypto_batch.prefetch_map(
+                decryptor.decrypt, job.encryptions, batch_size)
+            while True:
+                # clerk.decrypt now measures the WAIT for the bundle (the
+                # pool decrypts ahead), clerk.combine the stacked fold —
+                # their overlap is visible in the round timeline
+                with timed_phase("clerk.decrypt"):
+                    share_vectors = next(batches, None)
+                if share_vectors is None:
+                    break
+                with timed_phase("clerk.combine"):
+                    partial = combiner.combine(share_vectors)
+                    combined = (partial if combined is None
+                                else combiner.combine([combined, partial]))
+        if combined is None:  # empty job: keep the scalar path's shape
+            combined = combiner.combine([])
+
+        recipient_key = recipient_key_handle.result()
         encryptor = self.crypto.new_share_encryptor(
             recipient_key, aggregation.recipient_encryption_scheme
         )
@@ -301,6 +467,7 @@ class SdaClient:
     # Receiving (receive.rs)
 
     def upload_aggregation(self, aggregation: Aggregation) -> None:
+        self._invalidate(aggregation.id)
         self.service.create_aggregation(self.agent, aggregation)
 
     def begin_aggregation(self, aggregation_id: AggregationId) -> None:
@@ -311,6 +478,7 @@ class SdaClient:
         scheme so never faces this; with Paillier in the lattice, electing
         a Sodium-keyed clerk would only fail later at participate time).
         """
+        self._invalidate(aggregation_id)
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
             raise NotFound(f"unknown aggregation {aggregation_id}")
@@ -354,6 +522,7 @@ class SdaClient:
         unverifiable or wrong-variant key fails here, not at participate
         time.
         """
+        self._invalidate(aggregation_id)
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
             raise NotFound(f"unknown aggregation {aggregation_id}")
@@ -388,6 +557,7 @@ class SdaClient:
 
     def end_aggregation(self, aggregation_id: AggregationId) -> None:
         """Close the round by creating a snapshot (receive.rs:64-78)."""
+        self._invalidate(aggregation_id)
         with obs.span("recipient.snapshot",
                       attributes={"aggregation": str(aggregation_id)}):
             status = self.service.get_aggregation_status(self.agent, aggregation_id)
@@ -404,6 +574,7 @@ class SdaClient:
         earlier ones exist — round pipelining: several snapshots of one
         aggregation proceed through clerking independently (SURVEY §2.4;
         the reference server supports this, its client never drives it)."""
+        self._invalidate(aggregation_id)
         snapshot = Snapshot(id=SnapshotId.random(), aggregation=aggregation_id)
         with obs.span("recipient.snapshot",
                       attributes={"aggregation": str(aggregation_id),
@@ -424,10 +595,10 @@ class SdaClient:
     def _reveal_aggregation(
         self, aggregation_id: AggregationId, snapshot_id: Optional[SnapshotId]
     ) -> RecipientOutput:
-        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        aggregation = self._cached_aggregation(aggregation_id)
         if aggregation is None:
             raise NotFound(f"unknown aggregation {aggregation_id}")
-        committee = self.service.get_committee(self.agent, aggregation_id)
+        committee = self._cached_committee(aggregation_id)
         if committee is None:
             raise NotFound(f"unknown committee {aggregation_id}")
 
@@ -451,23 +622,27 @@ class SdaClient:
             aggregation.recipient_key, aggregation.recipient_encryption_scheme
         )
 
-        # combine masks (expanding seeds for ChaCha)
+        # combine masks (expanding seeds for ChaCha); the per-participant
+        # sealed-box opens fan out on the crypto pool
         with timed_phase("recipient.combine_masks"):
             if result.recipient_encryptions is None:
                 mask = np.zeros(0, dtype=np.int64)
             else:
-                decrypted = [decryptor.decrypt(e) for e in result.recipient_encryptions]
+                decrypted = crypto_batch.pmap(
+                    decryptor.decrypt, result.recipient_encryptions)
                 mask = self.crypto.new_mask_combiner(aggregation.masking_scheme).combine(decrypted)
 
         # decrypt clerk results, map clerk id -> committee index
         clerk_positions = {cid: ix for ix, (cid, _) in enumerate(committee.clerks_and_keys)}
-        indexed_shares = []
         with timed_phase("recipient.decrypt_results"):
-            for clerking_result in result.clerk_encryptions:
+            def decrypt_result(clerking_result):
                 ix = clerk_positions.get(clerking_result.clerk)
                 if ix is None:
                     raise NotFound(f"missing clerk {clerking_result.clerk}")
-                indexed_shares.append((ix, decryptor.decrypt(clerking_result.encryption)))
+                return (ix, decryptor.decrypt(clerking_result.encryption))
+
+            indexed_shares = crypto_batch.pmap(
+                decrypt_result, result.clerk_encryptions)
 
         reconstructor = self.crypto.new_secret_reconstructor(
             aggregation.committee_sharing_scheme, aggregation.vector_dimension

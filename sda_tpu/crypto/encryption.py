@@ -319,6 +319,13 @@ def _unframe_paillier(raw: bytes) -> Tuple[int, int, list]:
     return count, summands, ciphertexts
 
 
+#: Public names for the LEB128 framing helpers: the binary wire codec
+#: (``protocol/bincodec.py``) frames its lengths with the exact same
+#: encoding the PackedPaillier payload uses.
+leb128 = _leb128
+read_leb128 = _read_leb128
+
+
 def new_share_encryptor(ek: EncryptionKey, scheme: AdditiveEncryptionScheme) -> ShareEncryptor:
     if isinstance(scheme, SodiumEncryption):
         return SodiumEncryptor(ek)

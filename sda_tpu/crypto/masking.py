@@ -145,9 +145,10 @@ class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
         if self._device_backend():
             from ..fields import chacha_jax
 
+            # the expander takes any word sequence: hand it the stacked
+            # rows directly, no per-word Python-int materialization
             return chacha_jax.combine_masks(
-                [[int(w) for w in s] for s in stacked], self.dimension,
-                self.modulus, prg=self.prg,
+                stacked, self.dimension, self.modulus, prg=self.prg,
             )
         if native.available():
             return native.chacha_combine_masks(
@@ -156,7 +157,7 @@ class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
         result = np.zeros(self.dimension, dtype=np.int64)
         for seed in stacked:
             expanded = chacha.expand_mask_for(
-                self.prg, [int(w) for w in seed], self.dimension, self.modulus
+                self.prg, seed, self.dimension, self.modulus
             )
             result = (result + expanded) % self.modulus
         return result

@@ -43,8 +43,16 @@ from ..protocol import (
     SnapshotResult,
     signed_encryption_key_from_obj,
 )
+from ..protocol import bincodec
 
 TOKEN_ALIAS = "auth-token"
+
+#: Wire codec modes: "json" pins the legacy JSON wire, "bin" forces the
+#: binary codec from the first request (peer known to support it), "auto"
+#: starts JSON and upgrades the hot routes once the server's
+#: ``X-SDA-Codecs: bin`` advert is seen — old JSON-only servers therefore
+#: keep speaking JSON transparently.
+WIRE_CODECS = ("auto", "json", "bin")
 
 log = logging.getLogger(__name__)
 
@@ -146,10 +154,19 @@ class SdaHttpClient(SdaService):
         backoff_base: Optional[float] = None,
         backoff_cap: Optional[float] = None,
         deadline: Optional[float] = None,
+        codec: Optional[str] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.store = store
         self._fixed_token = token
+        #: wire codec mode; constructor beats SDA_WIRE_CODEC beats "auto"
+        self.codec = (codec if codec is not None
+                      else _os.environ.get("SDA_WIRE_CODEC") or "auto")
+        if self.codec not in WIRE_CODECS:
+            raise ValueError(f"unknown wire codec {self.codec!r} "
+                             f"(expected one of {WIRE_CODECS})")
+        #: set once any response carries the server's bin-codec advert
+        self._peer_bin = False
         #: per-request socket timeout; constructor beats SDA_HTTP_TIMEOUT
         #: beats the historical 60 s default
         self.timeout = (
@@ -232,7 +249,12 @@ class SdaHttpClient(SdaService):
             raise InvalidRequest(body)
         raise ServerError(f"HTTP {response.status_code}: {body}")
 
-    def _request(self, method: str, path: str, *, params=None, json=None, auth=None):
+    def _use_bin(self) -> bool:
+        """Whether the hot routes should speak binary right now."""
+        return self.codec == "bin" or (self.codec == "auto" and self._peer_bin)
+
+    def _request(self, method: str, path: str, *, params=None, json=None,
+                 data=None, headers=None, auth=None, stream=False):
         """One logical operation: exponential-backoff retries around the
         raw HTTP exchange, bounded by ``max_retries`` AND the
         per-operation ``deadline``. Connection errors, timeouts, 5xx
@@ -266,14 +288,13 @@ class SdaHttpClient(SdaService):
                     "http.attempt", kind="client",
                     attributes={"attempt": attempt},
                 ) as att_span:
-                    headers = {
-                        obs.TRACEPARENT_HEADER:
-                            obs.format_traceparent(att_span.context)
-                    }
+                    send_headers = dict(headers or {})
+                    send_headers[obs.TRACEPARENT_HEADER] = (
+                        obs.format_traceparent(att_span.context))
                     try:
                         response = self.session.request(
-                            method, url, params=params, json=json, auth=auth,
-                            headers=headers,
+                            method, url, params=params, json=json, data=data,
+                            auth=auth, headers=send_headers, stream=stream,
                             timeout=min(self.timeout, max(0.05, remaining)),
                         )
                     except requests.Timeout as e:
@@ -281,6 +302,11 @@ class SdaHttpClient(SdaService):
                     except requests.ConnectionError as e:
                         cause, error = "connection", e
                     else:
+                        if not self._peer_bin and "bin" in response.headers.get(
+                                bincodec.CODECS_HEADER, ""):
+                            # codec advert: every later hot-route request
+                            # from this proxy may upgrade to binary
+                            self._peer_bin = True
                         att_span.set_attribute(
                             "http.status", response.status_code)
                         request_id = response.headers.get(
@@ -298,9 +324,21 @@ class SdaHttpClient(SdaService):
                             if attempt:
                                 metrics.count("http.retry.recovered")
                                 op_span.set_attribute("retries", attempt)
+                            if stream:
+                                # one bulk read instead of requests' 10 KB
+                                # chunk loop — matters at multi-MB clerk-job
+                                # payloads; ``.content`` then serves callers
+                                # from this buffer
+                                response._content = response.raw.read(
+                                    decode_content=True)
+                                response._content_consumed = True
                             return response
                     if error is not None:
                         att_span.set_attribute("error", cause)
+                    elif stream:
+                        # unread streamed body of a retryable response:
+                        # drop the connection rather than poison keep-alive
+                        response.close()
                     if retry_after is not None:
                         att_span.set_attribute("retry_after_s", retry_after)
                 attempt += 1
@@ -340,7 +378,7 @@ class SdaHttpClient(SdaService):
             self._request("GET", path, params=params, auth=self._auth(caller))
         )
 
-    def _post(self, caller: Agent, path: str, obj) -> None:
+    def _post(self, caller: Agent, path: str, obj, resource=None) -> None:
         # POSTs are only retry-safe because every mutating route is a
         # create-once/idempotent upsert server-side — enforce the claim
         # (explicit raise, not `assert`: must survive python -O)
@@ -349,8 +387,21 @@ class SdaHttpClient(SdaService):
                 f"POST {path} is not classified retry-safe; add it to "
                 "_IDEMPOTENT_POST_ROUTES only if its handler is idempotent"
             )
+        if resource is not None and self._use_bin():
+            # negotiated hot-route body: one binary frame instead of
+            # base64-inside-JSON; the raw bytes re-send identically on
+            # retries, so retry semantics are unchanged
+            self._check(self._request(
+                "POST", path, data=bincodec.encode(resource),
+                headers={"Content-Type": bincodec.CONTENT_TYPE},
+                auth=self._auth(caller),
+            ))
+            return
+        # ``obj`` may be a thunk so hot callers skip building the (large)
+        # JSON tree when the binary path was taken
         self._check(
-            self._request("POST", path, json=obj, auth=self._auth(caller))
+            self._request("POST", path, json=obj() if callable(obj) else obj,
+                          auth=self._auth(caller))
         )
 
     def _delete(self, caller: Agent, path: str) -> None:
@@ -448,13 +499,27 @@ class SdaHttpClient(SdaService):
         )
 
     def create_participation(self, caller, participation):
-        self._post(caller, "/v1/aggregations/participations", participation.to_obj())
+        self._post(caller, "/v1/aggregations/participations",
+                   participation.to_obj, resource=participation)
 
     def get_clerking_job(self, caller, clerk):
-        response = self._get(caller, "/v1/aggregations/any/jobs")
+        headers = None
+        if self.codec != "json":
+            # offer the binary codec for the bulkiest download of a round;
+            # an old server ignores the Accept header and answers JSON
+            headers = {"Accept":
+                       f"{bincodec.CONTENT_TYPE}, application/json"}
+        response = self._check(self._request(
+            "GET", "/v1/aggregations/any/jobs", headers=headers,
+            auth=self._auth(caller), stream=True,
+        ))
         if response is None:
             return None
-        job = ClerkingJob.from_obj(response.json())
+        ctype = (response.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == bincodec.CONTENT_TYPE:
+            job = bincodec.decode_clerking_job(response.content)
+        else:
+            job = ClerkingJob.from_obj(response.json())
         # the server hands back the trace context the job was enqueued
         # under (X-Trace-Context); mirror it locally so processing — even
         # of a lease-REISSUED job — parents to the original round trace
@@ -466,5 +531,6 @@ class SdaHttpClient(SdaService):
 
     def create_clerking_result(self, caller, result):
         self._post(
-            caller, f"/v1/aggregations/implied/jobs/{result.job}/result", result.to_obj()
+            caller, f"/v1/aggregations/implied/jobs/{result.job}/result",
+            result.to_obj, resource=result,
         )

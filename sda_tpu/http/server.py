@@ -64,6 +64,7 @@ from ..protocol import (
     SnapshotId,
     signed_encryption_key_from_obj,
 )
+from ..protocol import bincodec
 from ..server import SdaServerService, auth_token
 from ..utils import metrics
 from .. import chaos, obs
@@ -147,16 +148,43 @@ class _Handler(BaseHTTPRequestHandler):
             raise InvalidCredentials("missing Basic auth")
         return self.service.server.check_auth_token(auth_token(*creds))
 
-    def _json_body(self):
+    def _raw_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", 0))
         raw = self.rfile.read(length) if length else b""
         self._body_consumed = True
+        return raw
+
+    def _json_body(self):
+        raw = self._raw_body()
         if not raw:
             return None
         try:
             return json.loads(raw)
         except json.JSONDecodeError as e:
             raise InvalidRequest(f"malformed JSON body: {e}")
+
+    # -- binary wire codec (application/x-sda-bin) -------------------------
+    def _bin_enabled(self) -> bool:
+        return getattr(self.server, "bin_codec", True)
+
+    def _body_is_bin(self) -> bool:
+        ctype = (self.headers.get("Content-Type") or "")
+        return (self._bin_enabled()
+                and ctype.split(";")[0].strip().lower() == bincodec.CONTENT_TYPE)
+
+    def _accepts_bin(self) -> bool:
+        return (self._bin_enabled()
+                and bincodec.CONTENT_TYPE in (self.headers.get("Accept") or ""))
+
+    def _hot_body(self, decode_bin, from_obj):
+        """Decode a hot-route POST body by its content type: negotiated
+        binary frame or the JSON fallback (old peers). Codec decode
+        errors raise ValueError -> 400, exactly like malformed JSON."""
+        if self._body_is_bin():
+            metrics.count("http.codec.bin.in")
+            return decode_bin(self._raw_body())
+        metrics.count("http.codec.json.in")
+        return from_obj(self._json_body())
 
     def _reply(self, status: int, obj=None, resource_not_found=False,
                retry_after=None, raw=None, content_type="application/json",
@@ -236,6 +264,10 @@ class _Handler(BaseHTTPRequestHandler):
             # echo the correlation id on EVERY response (reused from the
             # request when the client sent one, minted server-side else)
             self.send_header(obs.REQUEST_ID_HEADER, self._request_id)
+        if self._bin_enabled():
+            # codec advert: clients in "auto" mode upgrade the hot routes
+            # to application/x-sda-bin after seeing this on ANY response
+            self.send_header(bincodec.CODECS_HEADER, "bin")
         if extra_headers:
             for key, value in extra_headers.items():
                 self.send_header(key, value)
@@ -439,7 +471,8 @@ class _Handler(BaseHTTPRequestHandler):
                         self.service.get_committee(caller, AggregationId(r.group(1)))
                     )
             if path == "/v1/aggregations/participations" and method == "POST":
-                participation = Participation.from_obj(self._json_body())
+                participation = self._hot_body(
+                    bincodec.decode_participation, Participation.from_obj)
                 self.service.create_participation(caller, participation)
                 return self._reply(201)
             if r := m(rf"/v1/aggregations/({_ID})/status"):
@@ -464,10 +497,20 @@ class _Handler(BaseHTTPRequestHandler):
                     if link is not None:
                         headers = {obs.TRACE_CONTEXT_HEADER:
                                    obs.format_traceparent(link)}
+                if job is not None and self._accepts_bin():
+                    # negotiated response codec: the job payload is the
+                    # bulkiest download of a round (a whole clerk column)
+                    metrics.count("http.codec.bin.out")
+                    return self._reply(
+                        200, raw=bincodec.encode_clerking_job(job),
+                        content_type=bincodec.CONTENT_TYPE,
+                        extra_headers=headers,
+                    )
                 return self._reply_option(job, extra_headers=headers)
             if r := m(rf"/v1/aggregations/implied/jobs/({_ID})/result"):
                 if method == "POST":
-                    result = ClerkingResult.from_obj(self._json_body())
+                    result = self._hot_body(
+                        bincodec.decode_clerking_result, ClerkingResult.from_obj)
                     if str(result.job) != r.group(1).lower():
                         raise InvalidRequest("result job id does not match route")
                     self.service.create_clerking_result(caller, result)
@@ -551,7 +594,10 @@ class SdaHttpServer:
     (uptime, store backend, in-flight/peak gauges, lease stats, devprof
     compile totals — same opt-in reasoning, ``sdad --statusz``);
     ``trace_log`` logs one INFO line per finished server span (trace id,
-    route, status, request id — ``sdad --trace``).
+    route, status, request id — ``sdad --trace``);
+    ``bin_codec=False`` turns the binary wire codec off (no advert, no
+    ``application/x-sda-bin`` parsing) — the old-JSON-server posture the
+    mixed-version tests pin.
     """
 
     def __init__(
@@ -565,9 +611,11 @@ class SdaHttpServer:
         metrics_endpoint: bool = False,
         statusz_endpoint: bool = False,
         trace_log: bool = False,
+        bin_codec: bool = True,
     ):
         host, _, port = bind.partition(":")
         self.httpd = ThreadingHTTPServer((host, int(port or 8888)), _Handler)
+        self.httpd.bin_codec = bin_codec  # type: ignore[attr-defined]
         self.httpd.sda_service = service  # type: ignore[attr-defined]
         self.httpd.status_counts = {}  # type: ignore[attr-defined]
         self.httpd.stats_lock = threading.Lock()  # type: ignore[attr-defined]

@@ -73,6 +73,9 @@ class LoadProfile:
     chaos_rate: float = 0.0
     lease_seconds: float = 2.0
     timeout_s: float = 300.0
+    # wire codec for every client in the swarm: "auto" (upgrade on the
+    # server's advert), "json" (legacy wire pinned), "bin" (forced binary)
+    codec: str = "auto"
 
 
 def _percentiles_ms(summary: dict) -> dict:
@@ -162,6 +165,7 @@ def run_load(profile: LoadProfile) -> dict:
                 # converge through Retry-After hints within the deadline
                 max_retries=16, backoff_base=0.01, backoff_cap=0.25,
                 deadline=profile.timeout_s,
+                codec=profile.codec,
             )
 
             def new_client():
@@ -324,7 +328,10 @@ def run_load(profile: LoadProfile) -> dict:
         http_server.shutdown()
 
     counters = metrics.counter_report()
+    codec_counters = metrics.counter_report("http.codec.") or None
     lag_summary = metrics.histogram_report("load.lag").get("load.lag")
+    clerk_job_summary = metrics.histogram_report("clerk.job.").get(
+        "clerk.job.seconds")
     requests_total = sum(status_counts.values())
     shed = sum(v for k, v in status_counts.items() if k == 429)
     errors_5xx = sum(v for k, v in status_counts.items() if k >= 500)
@@ -339,6 +346,14 @@ def run_load(profile: LoadProfile) -> dict:
         "participants": profile.participants,
         "dim": profile.dim,
         "clerks": scheme.share_count,
+        # the wire the swarm actually spoke (an "auto" run that upgraded
+        # records "bin"): the regression gate keys comparability on this,
+        # so it must name the negotiated outcome, not the requested mode
+        "codec": ("bin" if (codec_counters or {}).get("http.codec.bin.in")
+                  or (codec_counters or {}).get("http.codec.bin.out")
+                  else "json"),
+        "codec_mode": profile.codec,
+        "codec_counters": codec_counters,
         "arrivals": profile.arrivals,
         "target_rps": profile.target_rps if profile.arrivals == "open" else None,
         "concurrency": profile.concurrency,
@@ -374,6 +389,11 @@ def run_load(profile: LoadProfile) -> dict:
             for name, summary in
             metrics.histogram_report("load.phase.").items()
         },
+        # clerk-job wall time (decrypt pipeline + combine + re-encrypt +
+        # result upload): the host-hot-path headline the batched clerk
+        # pipeline moves
+        "clerk_job_ms": (_percentiles_ms(clerk_job_summary)
+                         if clerk_job_summary else None),
         "lag_ms": _percentiles_ms(lag_summary) if lag_summary else None,
         # the three slowest participants with the span chain that made them
         # slow (retry attempts, server handling, store ops) — tail

@@ -11,8 +11,10 @@ noise-aware per-metric gate:
   bench's "no rung finished" line, a wrapper whose ``parsed`` is null —
   are SKIPPED, never flagged: a failed measurement is not a regression.
 - **Comparability**: a record only gates against trailing records with
-  the same ``platform`` and ``metric`` string (a CPU fallback must never
-  be judged against chip numbers — ROOFLINE.md's 3-orders gap).
+  the same ``platform``, ``metric`` and (when tagged) ``codec`` string
+  (a CPU fallback must never be judged against chip numbers —
+  ROOFLINE.md's 3-orders gap; a binary-wire loadgen number must never
+  gate against JSON-wire history).
 - **Noise awareness**: the threshold is
   ``max(floor, Z x relstd(window), Z x chain_rel)`` where ``relstd`` is
   the trailing window's empirical run-to-run variance and ``chain_rel``
@@ -112,8 +114,12 @@ def load_records(paths) -> List[dict]:
 
 
 def _comparable(newest: dict, rec: dict) -> bool:
+    # codec is part of a record's identity: a binary-wire loadgen number
+    # must never gate against JSON-wire history (the codec IS the
+    # variable under test); records without the tag compare as before
     return (rec.get("platform") == newest.get("platform")
-            and rec.get("metric") == newest.get("metric"))
+            and rec.get("metric") == newest.get("metric")
+            and rec.get("codec") == newest.get("codec"))
 
 
 def chain_rel_uncertainty(rec: dict) -> float:

@@ -271,6 +271,22 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
                     out.append(Participation.from_obj(obj))
             return out
 
+    def iter_snapped_recipient_encryptions(self, aggregation, snapshot):
+        # mask-column read: decode only the recipient_encryption field of
+        # each frozen document instead of re-materializing every
+        # participation a second time
+        with self._lock:
+            part_ids = _read_json(self.root / "snapshot_parts" / f"{snapshot}.json") or []
+            out = []
+            for pid in part_ids:
+                obj = _read_json(
+                    self.root / "participations" / str(aggregation) / f"{pid}.json"
+                )
+                if obj is not None:
+                    enc = obj.get("recipient_encryption")
+                    out.append(None if enc is None else Encryption.from_obj(enc))
+            return out
+
     def create_snapshot_mask(self, snapshot, mask):
         with self._lock:
             _write_json(
@@ -292,6 +308,19 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
             _write_json(
                 self.root / "queue" / str(job.clerk) / f"{job.id}.json", job.to_obj()
             )
+
+    def enqueue_clerking_jobs(self, jobs):
+        jobs = list(jobs)
+        for _ in jobs:
+            chaos.fail("store.enqueue_clerking_job")
+        with self._lock:  # one lock hold for the whole fan-out
+            for job in jobs:
+                if (self.root / "done" / str(job.clerk) / f"{job.id}.json").exists():
+                    continue  # snapshot retry: this job already completed
+                _write_json(
+                    self.root / "queue" / str(job.clerk) / f"{job.id}.json",
+                    job.to_obj(),
+                )
 
     def poll_clerking_job(self, clerk):
         chaos.fail("store.poll_clerking_job")

@@ -222,6 +222,16 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
                 return  # snapshot retry: this job already completed
             self._queues.setdefault(job.clerk, OrderedDict())[job.id] = job
 
+    def enqueue_clerking_jobs(self, jobs):
+        jobs = list(jobs)
+        for _ in jobs:
+            chaos.fail("store.enqueue_clerking_job")
+        with self._lock:  # one lock hold for the whole fan-out
+            for job in jobs:
+                if job.id in self._done.get(job.clerk, {}):
+                    continue  # snapshot retry: this job already completed
+                self._queues.setdefault(job.clerk, OrderedDict())[job.id] = job
+
     def poll_clerking_job(self, clerk):
         chaos.fail("store.poll_clerking_job")
         with self._lock:

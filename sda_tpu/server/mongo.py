@@ -190,20 +190,21 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
             upsert=True,
         )
 
+    @staticmethod
+    def _participation_doc(participation):
+        return {
+            "_id": str(participation.id),
+            "aggregation": str(participation.aggregation),
+            "snapshots": [],
+            "doc": participation.to_obj(),
+        }
+
     def create_participation(self, participation):
         chaos.fail("store.create_participation")
         if self.get_aggregation(participation.aggregation) is None:
             raise NotFound("aggregation not found")
-        self.db.participations.replace_one(
-            {"_id": str(participation.id)},
-            {
-                "_id": str(participation.id),
-                "aggregation": str(participation.aggregation),
-                "snapshots": [],
-                "doc": participation.to_obj(),
-            },
-            upsert=True,
-        )
+        doc = self._participation_doc(participation)
+        self.db.participations.replace_one({"_id": doc["_id"]}, doc, upsert=True)
 
     def create_snapshot(self, snapshot):
         chaos.fail("store.create_snapshot")
@@ -282,23 +283,63 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
 
 
 class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
-    def enqueue_clerking_job(self, job):
-        chaos.fail("store.enqueue_clerking_job")
-        payload = {
+    @staticmethod
+    def _job_doc(job):
+        return {
             "_id": str(job.id),
             "clerk": str(job.clerk),
             "snapshot": str(job.snapshot),
             "done": False,
             "doc": job.to_obj(),
         }
+
+    def _enqueue_doc(self, payload):
         # refresh only a still-QUEUED job; a snapshot replay must never
         # resurrect a done job or wipe its embedded result
         res = self.db.clerking_jobs.replace_one(
-            {"_id": str(job.id), "done": False}, payload
+            {"_id": payload["_id"], "done": False}, payload
         )
         if res.matched_count == 0:
             self.db.clerking_jobs.update_one(
-                {"_id": str(job.id)}, {"$setOnInsert": payload}, upsert=True
+                {"_id": payload["_id"]}, {"$setOnInsert": payload}, upsert=True
+            )
+
+    def enqueue_clerking_job(self, job):
+        chaos.fail("store.enqueue_clerking_job")
+        self._enqueue_doc(self._job_doc(job))
+
+    def enqueue_clerking_jobs(self, jobs):
+        # the snapshot fan-out in three round trips under the real driver
+        # (refresh-queued bulk, existence probe, insert-missing bulk)
+        # instead of 2C; same never-resurrect-done semantics per job
+        jobs = list(jobs)
+        if not jobs:
+            return
+        for _ in jobs:
+            chaos.fail("store.enqueue_clerking_job")
+        payloads = [self._job_doc(job) for job in jobs]
+        if not _PYMONGO:
+            for payload in payloads:
+                self._enqueue_doc(payload)
+            return
+        self.db.clerking_jobs.bulk_write(
+            [pymongo.ReplaceOne({"_id": p["_id"], "done": False}, p)
+             for p in payloads],
+            ordered=False,
+        )
+        existing = {
+            d["_id"]
+            for d in self.db.clerking_jobs.find(
+                {"_id": {"$in": [p["_id"] for p in payloads]}},
+                {"_id": 1})  # ids only: don't re-download the clerk columns
+        }
+        missing = [p for p in payloads if p["_id"] not in existing]
+        if missing:
+            self.db.clerking_jobs.bulk_write(
+                [pymongo.UpdateOne({"_id": p["_id"]}, {"$setOnInsert": p},
+                                   upsert=True)
+                 for p in missing],
+                ordered=False,
             )
 
     def poll_clerking_job(self, clerk):

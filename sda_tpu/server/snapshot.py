@@ -139,6 +139,7 @@ def _snapshot_locked(server, snap: Snapshot) -> bool:
     log.debug("snapshot %s: enqueueing %d clerking jobs", snap.id, len(columns))
     with timed_phase("server.enqueue_jobs"):
         enqueue_ctx = obs.current_context()
+        jobs = []
         for (clerk_id, _), encryptions in zip(committee.clerks_and_keys, columns):
             job = ClerkingJob(
                 id=clerking_job_id(snap.id, clerk_id),
@@ -151,17 +152,23 @@ def _snapshot_locked(server, snap: Snapshot) -> bool:
             # (including a lease-reissued retry of the same deterministic
             # job id) re-parents to this round instead of its own poll
             obs.link_job(str(job.id), enqueue_ctx)
-            server.clerking_job_store.enqueue_clerking_job(job)
+            jobs.append(job)
+        # ONE bulk store write for the whole committee fan-out (a single
+        # transaction on sqlite, one lock hold on memory/jsonfs, batched
+        # round trips on mongo) instead of C commits of C full columns
+        server.clerking_job_store.enqueue_clerking_jobs(jobs)
 
     if aggregation.masking_scheme.has_mask:
         log.debug("snapshot %s: collecting recipient mask encryptions", snap.id)
+        # column read: only the recipient_encryption field of each frozen
+        # document, not a second full-participation materialization
         recipient_encryptions = []
-        for participation in server.aggregation_store.iter_snapped_participations(
+        for encryption in server.aggregation_store.iter_snapped_recipient_encryptions(
             snap.aggregation, snap.id
         ):
-            if participation.recipient_encryption is None:
+            if encryption is None:
                 raise NotFound("participation should have had a recipient encryption")
-            recipient_encryptions.append(participation.recipient_encryption)
+            recipient_encryptions.append(encryption)
         server.aggregation_store.create_snapshot_mask(snap.id, recipient_encryptions)
 
     # the snapshot record is the commit point and therefore goes LAST:

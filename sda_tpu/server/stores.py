@@ -164,6 +164,18 @@ class AggregationsStore(BaseStore):
                 columns[ix].append(encryption)
         return columns
 
+    def iter_snapped_recipient_encryptions(
+        self, aggregation: AggregationId, snapshot: SnapshotId
+    ) -> List[Optional[Encryption]]:
+        """The recipient-mask column of the frozen set, in participation
+        order (``None`` where a participation carried no mask). Backends
+        that store documents can extract just this field instead of
+        re-materializing every full participation a second time."""
+        return [
+            p.recipient_encryption
+            for p in self.iter_snapped_participations(aggregation, snapshot)
+        ]
+
     @abc.abstractmethod
     def create_snapshot_mask(
         self, snapshot: SnapshotId, mask: List[Encryption]
@@ -179,6 +191,14 @@ class ClerkingJobsStore(BaseStore):
         """Queue a job for its clerk. Must be an upsert keyed by
         ``(clerk, id)`` and must NOT resurrect a completed job — snapshot
         creation relies on this to be retry-idempotent."""
+
+    def enqueue_clerking_jobs(self, jobs: Iterable[ClerkingJob]) -> None:
+        """Bulk enqueue — the snapshot pipeline queues one job per
+        committee member in a single store transaction where the backend
+        supports it. The fallback loops; overrides must preserve the
+        per-job upsert + never-resurrect-done semantics."""
+        for job in jobs:
+            self.enqueue_clerking_job(job)
 
     @abc.abstractmethod
     def poll_clerking_job(self, clerk: AgentId) -> Optional[ClerkingJob]:

@@ -1,0 +1,243 @@
+"""Binary wire codec (``application/x-sda-bin``) contract.
+
+Three layers of pinning:
+
+- **Golden round-trips**: for each hot-path resource, the binary decode of
+  the binary encode equals the object AND equals what the JSON wire
+  produces from the same object — one resource, two wires, same value.
+- **Golden bytes**: a fixed participation encodes to pinned hex, so a
+  silent format drift (field order, endianness, framing) fails loudly
+  instead of corrupting cross-version traffic.
+- **Mixed-version negotiation** over the real HTTP stack: bin-capable
+  client against an old JSON-only server stays JSON; a JSON-pinned client
+  against a bin server stays JSON; auto against bin upgrades — and every
+  combination completes a bit-exact round.
+"""
+
+import uuid
+
+import numpy as np
+import pytest
+
+from sda_tpu.protocol import (
+    AgentId,
+    AggregationId,
+    Binary,
+    ClerkingJob,
+    ClerkingJobId,
+    ClerkingResult,
+    Encryption,
+    Participation,
+    ParticipationId,
+    SnapshotId,
+    bincodec,
+)
+
+
+def _uuid(n: int) -> uuid.UUID:
+    return uuid.UUID(int=n)
+
+
+def _participation(recipient_encryption=True, clerks=3) -> Participation:
+    return Participation(
+        id=ParticipationId(_uuid(1)),
+        participant=AgentId(_uuid(2)),
+        aggregation=AggregationId(_uuid(3)),
+        recipient_encryption=(
+            Encryption("Sodium", Binary(b"mask-ciphertext"))
+            if recipient_encryption else None
+        ),
+        clerk_encryptions=[
+            (AgentId(_uuid(10 + i)),
+             Encryption("Sodium", Binary(bytes([i]) * (i + 2))))
+            for i in range(clerks)
+        ],
+    )
+
+
+def _job() -> ClerkingJob:
+    return ClerkingJob(
+        id=ClerkingJobId(_uuid(4)),
+        clerk=AgentId(_uuid(5)),
+        aggregation=AggregationId(_uuid(6)),
+        snapshot=SnapshotId(_uuid(7)),
+        encryptions=[
+            Encryption("Sodium", Binary(b"column-entry-0")),
+            Encryption("PackedPaillier", Binary(b"\x02\x01\x01\x2a")),
+        ],
+    )
+
+
+def _result() -> ClerkingResult:
+    return ClerkingResult(
+        job=ClerkingJobId(_uuid(8)),
+        clerk=AgentId(_uuid(9)),
+        encryption=Encryption("Sodium", Binary(b"combined")),
+    )
+
+
+# -- golden round-trips ------------------------------------------------------
+
+@pytest.mark.parametrize("resource", [
+    _participation(),
+    _participation(recipient_encryption=False, clerks=1),
+    _participation(clerks=0),
+    _job(),
+    _result(),
+], ids=["participation", "participation-nomask", "participation-empty",
+        "job", "result"])
+def test_round_trip_equals_json_wire(resource):
+    decoded = bincodec.decode(bincodec.encode(resource))
+    assert decoded == resource
+    # same value as the JSON wire derives from the same object
+    assert decoded == type(resource).from_obj(resource.to_obj())
+
+
+def test_golden_bytes_pinned():
+    # format drift tripwire: any change to field order, framing, or
+    # endianness must show up here as a deliberate golden update
+    raw = bincodec.encode(_participation(clerks=1))
+    assert raw.hex() == (
+        "53444142"  # magic "SDAB"
+        "01"        # version
+        "01"        # tag: participation
+        "00000000000000000000000000000001"  # id
+        "00000000000000000000000000000002"  # participant
+        "00000000000000000000000000000003"  # aggregation
+        "01"        # recipient encryption present
+        "00"        # variant Sodium
+        "000f" + b"mask-ciphertext".hex() +  # u1 array frame, len 15
+        "01"        # one clerk encryption
+        "0000000000000000000000000000000a"  # clerk id
+        "00"        # variant Sodium
+        "0002"      # u1 array frame, len 2
+        "0000"      # payload bytes([0]) * 2
+    )
+
+
+def test_binary_is_smaller_than_json():
+    import json
+
+    p = _participation(clerks=8)
+    assert len(bincodec.encode(p)) < len(json.dumps(p.to_obj()).encode())
+
+
+# -- array primitive ---------------------------------------------------------
+
+@pytest.mark.parametrize("arr", [
+    np.array([], dtype=np.int64),
+    np.array([-5, 0, 7, 2**62, -(2**62)], dtype=np.int64),
+    np.arange(100, dtype=np.uint32),
+    np.frombuffer(b"raw-bytes", dtype=np.uint8),
+])
+def test_array_round_trip(arr):
+    out = []
+    bincodec.write_array(out, arr)
+    decoded, pos = bincodec.read_array(b"".join(out), 0)
+    assert pos == len(b"".join(out))
+    assert decoded.dtype.kind == arr.dtype.kind
+    np.testing.assert_array_equal(decoded, arr)
+
+
+def test_array_rejects_garbage():
+    with pytest.raises(ValueError):
+        bincodec.read_array(b"\xff\x04abcd", 0)  # unknown dtype tag
+    out = []
+    bincodec.write_array(out, np.array([1, 2], dtype=np.int64))
+    with pytest.raises(ValueError):
+        bincodec.read_array(b"".join(out)[:-3], 0)  # truncated payload
+
+
+# -- malformed payloads ------------------------------------------------------
+
+@pytest.mark.parametrize("mutate", [
+    lambda raw: b"JSON" + raw[4:],          # bad magic
+    lambda raw: raw[:4] + b"\x63" + raw[5:],  # wrong version
+    lambda raw: raw[:5] + b"\x7f" + raw[6:],  # unknown tag
+    lambda raw: raw[:-1],                    # truncated
+    lambda raw: raw + b"\x00",               # trailing bytes
+], ids=["magic", "version", "tag", "truncated", "trailing"])
+def test_malformed_payload_raises(mutate):
+    raw = bincodec.encode(_participation())
+    with pytest.raises(ValueError):
+        bincodec.decode(mutate(raw))
+
+
+def test_decode_rejects_wrong_resource_for_typed_decoder():
+    with pytest.raises(ValueError):
+        bincodec.decode_clerking_job(bincodec.encode(_result()))
+
+
+# -- mixed-version negotiation over the real HTTP stack ----------------------
+
+sodium_available = pytest.importorskip(
+    "sda_tpu.crypto.sodium", reason="libsodium needed"
+).available()
+pytestmark_http = pytest.mark.skipif(not sodium_available,
+                                     reason="libsodium not present")
+
+
+@pytest.fixture
+def codec_counters():
+    from sda_tpu import obs
+    from sda_tpu.utils import metrics
+
+    obs.reset_all()
+    yield lambda: metrics.counter_report("http.codec.")
+    obs.reset_all()
+
+
+def _run_round(codec: str, bin_server: bool):
+    import test_full_loop as tfl
+    from sda_tpu.http import SdaHttpClient, SdaHttpServer
+    from sda_tpu.server import new_memory_server
+
+    server = SdaHttpServer(new_memory_server(), bind="127.0.0.1:0",
+                           bin_codec=bin_server).start_background()
+    try:
+        proxy = SdaHttpClient(server.address, token="codec-test", codec=codec)
+        tfl.check_full_aggregation(tfl.agg_default(), proxy)
+    finally:
+        server.shutdown()
+
+
+@pytestmark_http
+def test_auto_client_upgrades_against_bin_server(codec_counters):
+    _run_round("auto", bin_server=True)
+    counters = codec_counters()
+    # hot POSTs (participations + results) binary, job downloads binary
+    assert counters.get("http.codec.bin.in", 0) > 0
+    assert counters.get("http.codec.bin.out", 0) > 0
+
+
+@pytestmark_http
+def test_auto_client_stays_json_against_old_server(codec_counters):
+    # old server: no advert, no binary parsing — the round still works
+    _run_round("auto", bin_server=False)
+    counters = codec_counters()
+    assert counters.get("http.codec.bin.in", 0) == 0
+    assert counters.get("http.codec.bin.out", 0) == 0
+
+
+@pytestmark_http
+def test_json_pinned_client_stays_json_against_bin_server(codec_counters):
+    _run_round("json", bin_server=True)
+    counters = codec_counters()
+    assert counters.get("http.codec.bin.in", 0) == 0
+    assert counters.get("http.codec.bin.out", 0) == 0
+    assert counters.get("http.codec.json.in", 0) > 0
+
+
+@pytestmark_http
+def test_forced_bin_client_against_bin_server(codec_counters):
+    _run_round("bin", bin_server=True)
+    counters = codec_counters()
+    assert counters.get("http.codec.bin.in", 0) > 0
+    assert counters.get("http.codec.json.in", 0) == 0
+
+
+def test_unknown_codec_mode_rejected():
+    from sda_tpu.http import SdaHttpClient
+
+    with pytest.raises(ValueError):
+        SdaHttpClient("http://localhost:1", token="t", codec="cbor")

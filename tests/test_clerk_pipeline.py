@@ -1,0 +1,302 @@
+"""Batched clerk pipeline: bit-exactness, overlap machinery, doc cache.
+
+The clerk hot path decrypts in bundles on the crypto worker pool and
+feeds each bundle into one stacked combine, folding partial sums
+modularly. The contract: the revealed aggregate is BIT-EXACT with the
+scalar (workers=1, batch=everything) path — under every batch size, under
+chaos failpoints, and with the client-side document cache on or off.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sda_tpu import chaos, obs
+from sda_tpu.client import RecipientOutput, SdaClient
+from sda_tpu.crypto import MemoryKeystore, sodium
+from sda_tpu.crypto import batch as crypto_batch
+from sda_tpu.protocol import (
+    Aggregation,
+    AggregationId,
+    AgentId,
+    EncryptionKeyId,
+    FullMasking,
+    PackedShamirSharing,
+    SodiumEncryption,
+)
+from sda_tpu.server import new_memory_server
+
+
+# -- pool primitives ---------------------------------------------------------
+
+def test_pmap_preserves_order(monkeypatch):
+    monkeypatch.setenv("SDA_CRYPTO_WORKERS", "4")
+    crypto_batch.reset()
+    try:
+        def slow_identity(x):
+            time.sleep(0.002 * (7 - x % 8))  # later items finish earlier
+            return x * x
+        assert crypto_batch.pmap(slow_identity, range(16)) == [
+            x * x for x in range(16)]
+    finally:
+        crypto_batch.reset()
+
+
+def test_pmap_propagates_exceptions(monkeypatch):
+    monkeypatch.setenv("SDA_CRYPTO_WORKERS", "4")
+    crypto_batch.reset()
+    try:
+        def boom(x):
+            if x == 5:
+                raise RuntimeError("item 5")
+            return x
+        with pytest.raises(RuntimeError, match="item 5"):
+            crypto_batch.pmap(boom, range(8))
+    finally:
+        crypto_batch.reset()
+
+
+@pytest.mark.parametrize("workers", ["0", "1", "4"])
+def test_prefetch_map_yields_ordered_batches(monkeypatch, workers):
+    monkeypatch.setenv("SDA_CRYPTO_WORKERS", workers)
+    crypto_batch.reset()
+    try:
+        batches = list(crypto_batch.prefetch_map(
+            lambda x: x + 100, list(range(10)), batch_size=3))
+        assert batches == [[100, 101, 102], [103, 104, 105],
+                           [106, 107, 108], [109]]
+    finally:
+        crypto_batch.reset()
+
+
+def test_prefetch_map_bounds_staging(monkeypatch):
+    # at most (prefetch + 1) batches may ever be in flight or staged:
+    # the double buffer, not an unbounded decrypt-everything-first queue
+    monkeypatch.setenv("SDA_CRYPTO_WORKERS", "8")
+    crypto_batch.reset()
+    started = []
+    lock = threading.Lock()
+    try:
+        def track(x):
+            with lock:
+                started.append(x)
+            return x
+
+        stream = crypto_batch.prefetch_map(track, list(range(100)),
+                                           batch_size=10, prefetch=1)
+        next(stream)
+        time.sleep(0.05)  # let any runaway submissions surface
+        with lock:
+            assert len(started) <= 30  # batch 0 + at most 2 ahead
+    finally:
+        crypto_batch.reset()
+
+
+# -- end-to-end bit-exactness ------------------------------------------------
+
+pytestmark_sodium = pytest.mark.skipif(not sodium.available(),
+                                       reason="libsodium not present")
+
+SCHEME = PackedShamirSharing(3, 8, 4, 433, 354, 150)
+DIM = 6
+PARTICIPANTS = 7
+
+
+def _run_round(seed: int) -> np.ndarray:
+    """One full in-process round; returns the revealed positive values."""
+    obs.reset_all()
+    service = new_memory_server()
+
+    def new_client():
+        keystore = MemoryKeystore()
+        agent = SdaClient.new_agent(keystore)
+        return SdaClient(agent, keystore, service)
+
+    recipient = new_client()
+    recipient_key = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(recipient_key)
+
+    clerks = [new_client() for _ in range(SCHEME.share_count)]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+
+    aggregation = Aggregation(
+        id=AggregationId.random(),
+        title="pipeline-equivalence",
+        vector_dimension=DIM,
+        modulus=SCHEME.prime_modulus,
+        recipient=recipient.agent.id,
+        recipient_key=recipient_key,
+        masking_scheme=FullMasking(SCHEME.prime_modulus),
+        committee_sharing_scheme=SCHEME,
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    recipient.upload_aggregation(aggregation)
+    recipient.begin_aggregation(aggregation.id)
+
+    rng = np.random.default_rng(seed)
+    inputs = rng.integers(0, SCHEME.prime_modulus,
+                          size=(PARTICIPANTS, DIM), dtype=np.int64)
+    for row in inputs:
+        participant = new_client()
+        participant.upload_agent()
+        participant.participate([int(x) for x in row], aggregation.id)
+
+    recipient.end_aggregation(aggregation.id)
+    # several sweeps: under the chaos profile a worker may abandon a job
+    # mid-sweep (clerk.abandon_job drop) — the job stays queued and a
+    # later sweep picks it up, exactly like a re-polling clerk fleet
+    for _ in range(4):
+        for worker in [recipient] + clerks:
+            worker.run_chores(-1)
+
+    output = recipient.reveal_aggregation(aggregation.id)
+    expected = inputs.sum(axis=0) % SCHEME.prime_modulus
+    np.testing.assert_array_equal(output.positive().values, expected)
+    return np.asarray(output.positive().values)
+
+
+@pytestmark_sodium
+@pytest.mark.parametrize("batch,workers", [
+    ("1", "0"),    # scalar: one vector at a time, no threads
+    ("2", "4"),    # tiny bundles, real overlap
+    ("3", "2"),
+    ("4096", "8"),  # one bundle for everything
+])
+def test_batched_clerk_is_bit_exact_with_scalar(monkeypatch, batch, workers):
+    monkeypatch.setenv("SDA_CLERK_BATCH", batch)
+    monkeypatch.setenv("SDA_CRYPTO_WORKERS", workers)
+    crypto_batch.reset()
+    try:
+        # the fixed seed pins the participant inputs, so _run_round's
+        # internal assert against the plain sum IS the scalar verdict —
+        # every parametrization must land on identical values
+        out = _run_round(seed=20260803)
+        assert out.shape == (DIM,)
+    finally:
+        crypto_batch.reset()
+
+
+@pytestmark_sodium
+def test_batched_clerk_exact_under_chaos(monkeypatch):
+    # the pipeline must stay bit-exact when failpoints abandon clerk jobs
+    # mid-round (lease reissue brings them back) — chaos changes WHO
+    # processes a job, never the partial sums
+    monkeypatch.setenv("SDA_CLERK_BATCH", "2")
+    monkeypatch.setenv("SDA_CRYPTO_WORKERS", "4")
+    crypto_batch.reset()
+    chaos.reset()
+    try:
+        chaos.configure("clerk.abandon_job", drop=True, after=1, every=3,
+                        times=4)
+        _run_round(seed=20260803)  # asserts exactness internally
+    finally:
+        chaos.reset()
+        crypto_batch.reset()
+
+
+@pytestmark_sodium
+def test_cache_disabled_round_still_exact(monkeypatch):
+    monkeypatch.setenv("SDA_CLIENT_CACHE", "0")
+    _run_round(seed=7)
+
+
+# -- document cache ----------------------------------------------------------
+
+class _CountingService:
+    """Service wrapper counting the immutable-doc fetches."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.counts = {"get_aggregation": 0, "get_committee": 0,
+                       "get_encryption_key": 0}
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if name in self.counts:
+            def counted(*args, **kwargs):
+                self.counts[name] += 1
+                return fn(*args, **kwargs)
+            return counted
+        return fn
+
+
+@pytestmark_sodium
+def test_clerk_polling_uses_cached_documents():
+    service = new_memory_server()
+    counting = _CountingService(service)
+
+    def new_client(svc):
+        keystore = MemoryKeystore()
+        agent = SdaClient.new_agent(keystore)
+        return SdaClient(agent, keystore, svc)
+
+    recipient = new_client(service)
+    recipient_key = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(recipient_key)
+
+    clerks = [new_client(service) for _ in range(SCHEME.share_count)]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+
+    aggregation = Aggregation(
+        id=AggregationId.random(), title="cache", vector_dimension=DIM,
+        modulus=SCHEME.prime_modulus, recipient=recipient.agent.id,
+        recipient_key=recipient_key,
+        masking_scheme=FullMasking(SCHEME.prime_modulus),
+        committee_sharing_scheme=SCHEME,
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    recipient.upload_aggregation(aggregation)
+    recipient.begin_aggregation(aggregation.id)
+
+    for _ in range(3):
+        p = new_client(service)
+        p.upload_agent()
+        p.participate([1] * DIM, aggregation.id)
+
+    # three pipelined snapshots -> three jobs per committee member
+    for _ in range(3):
+        recipient.snapshot_aggregation(aggregation.id)
+
+    committee = service.get_committee(recipient.agent, aggregation.id)
+    committee_ids = {cid for cid, _ in committee.clerks_and_keys}
+    worker = next(c for c in [recipient] + clerks
+                  if c.agent.id in committee_ids)
+    counted_clerk = SdaClient(worker.agent, worker.crypto.keystore, counting)
+    processed = 0
+    while counted_clerk.clerk_once():
+        processed += 1
+    assert processed == 3
+    # one fetch each despite three jobs: the cache held between polls
+    assert counting.counts["get_aggregation"] == 1
+    assert counting.counts["get_committee"] == 1
+    # recipient key verified once, not once per job
+    assert counting.counts["get_encryption_key"] == 1
+
+
+# -- RecipientOutput lanes ---------------------------------------------------
+
+def test_recipient_output_int64_lane_stays_numpy():
+    out = RecipientOutput(433, [-5, 0, 432, 440])
+    lifted = out.positive()
+    assert lifted.values.dtype == np.int64
+    np.testing.assert_array_equal(lifted.values, [428, 0, 432, 7])
+
+
+def test_recipient_output_bigint_lane():
+    modulus = (1 << 80) + 13  # beyond int64: object lane, no silent wrap
+    values = [-(1 << 70), 1 << 79, 7]
+    out = RecipientOutput(modulus, values)
+    assert out.values.dtype == object
+    lifted = out.positive()
+    assert lifted.values.dtype == object
+    assert list(lifted.values) == [v % modulus for v in values]

@@ -105,6 +105,36 @@ def test_platform_mismatch_is_not_compared(tmp_path):
     assert "insufficient comparable history" in result["note"]
 
 
+def test_codec_tagged_record_is_not_compared_to_untagged_history():
+    # a codec-tagged record (the wire codec IS the variable under test in
+    # the ci.sh codec drill) opens its own trajectory: a 2x-slower value
+    # tagged codec=bin must NOT gate against the untagged JSON-wire
+    # history — and must not pass as its continuation either
+    paths = _history("BENCH_r06_codec_bin.json")
+    result = regress.check(regress.load_records(paths))
+    assert not result["checked"]
+    assert "insufficient comparable history" in result["note"]
+    assert regress.main(paths) == 0
+
+
+def test_codec_tagged_records_gate_among_themselves(tmp_path):
+    # same-codec records DO form a comparable window: a 2x slowdown
+    # within the bin-wire trajectory is still a confirmed regression
+    base = json.load(open(_fx("BENCH_r06_codec_bin.json")[0]))
+    paths = []
+    for n, value in enumerate([3600000, 3650000, 3580000, 1700000], start=6):
+        rec = json.loads(json.dumps(base))
+        rec["n"] = n
+        rec["parsed"]["value"] = value
+        rec["parsed"]["round_seconds_marginal"] = 1e7 / value
+        path = tmp_path / f"BENCH_r{n:02d}.json"
+        path.write_text(json.dumps(rec))
+        paths.append(str(path))
+    result = regress.check(regress.load_records(_history() + paths))
+    assert result["checked"]
+    assert "value" in result["regressions"]
+
+
 def test_json_output_mode(capsys):
     assert regress.main(_history() + ["--json"]) == 0
     out = capsys.readouterr().out.strip().splitlines()[-1]
