@@ -53,6 +53,38 @@ for codec, report in reports.items():
           f"rps={report['sustained_rps']} counters={counters[codec]}")
 PY
 
+echo "== fleet drill (fixed seed: 2 sdad processes, one shared sqlite store, chaos on, bit-exact)"
+FLEET_RECORD=$(mktemp /tmp/sda-fleet-XXXX.json)
+FLEET_REPORT=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 24 --dim 4 \
+  --load-arrivals closed --load-concurrency 8 --load-seed 20260803 \
+  --load-store sqlite --load-fleet 2 --load-chaos-rate 0.05)
+FLEET_REPORT="$FLEET_REPORT" FLEET_RECORD="$FLEET_RECORD" python - <<'PY'
+import json, os
+record = json.loads(os.environ["FLEET_REPORT"].strip().splitlines()[-1])
+# both rungs (1 worker, 2 workers) must close the round bit-exactly
+# with zero lost admitted participations and zero leaked requests —
+# even with ~5% of requests 500ing inside the worker processes
+assert record["fleet_nodes"] == 2, record
+assert record["ready"] and record["exact"], record
+assert record["client_failures"] == 0, record
+assert record["leaked"] == 0, record
+assert record["chaos_rate"] > 0, record
+# every worker actually served load-phase traffic
+assert all(rps > 0 for rps in record["per_node_load_rps"].values()), \
+    record["per_node_load_rps"]
+assert isinstance(record["scaling_efficiency"], float), record
+with open(os.environ["FLEET_RECORD"], "w") as f:
+    json.dump(record, f)
+print(f"fleet drill OK: {record['value']} rps @2 workers vs "
+      f"{record['baseline_rps']} @1, efficiency "
+      f"{record['scaling_efficiency']} ({record['host_cores']} cores), "
+      f"exact={record['exact']}")
+PY
+# the fresh scaling record must parse as a bench record and gate
+# (advisory: scaling efficiency is bounded by the CI host's core count)
+python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$FLEET_RECORD"
+rm -f "$FLEET_RECORD"
+
 echo "== trace smoke (fixed seed: Chrome-trace export, one connected round trace, bit-exact)"
 TRACE_OUT=$(mktemp /tmp/sda-trace-XXXX.json)
 TRACE_REPORT=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 12 --dim 4 \

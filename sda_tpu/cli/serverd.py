@@ -54,6 +54,28 @@ def build_parser() -> argparse.ArgumentParser:
                              "(default: unlimited)")
     parser.add_argument("--rate-burst", type=float, metavar="N", default=8.0,
                         help="token-bucket burst capacity per agent")
+    parser.add_argument("--node-id", metavar="NAME", default=None,
+                        help="fleet worker identity (sda-fleet): rides "
+                             "every response as X-SDA-Node, labels /metrics "
+                             "samples and /statusz, and lands on server "
+                             "spans so round timelines attribute hops to "
+                             "workers")
+    parser.add_argument("--fleet-peers", type=int, metavar="N", default=None,
+                        help="fleet size this worker belongs to (recorded "
+                             "as the fleet.peers gauge)")
+    parser.add_argument("--drain-grace", type=float, metavar="SECONDS",
+                        default=10.0,
+                        help="graceful-drain budget on SIGTERM/SIGINT: stop "
+                             "accepting, wait up to SECONDS for in-flight "
+                             "requests, release held clerking-job leases "
+                             "back to the shared store, then exit")
+    parser.add_argument("--chaos-spec", type=str, default=None,
+                        help="arm failpoints in THIS worker process, e.g. "
+                             "'http.server.request=error,rate=0.05' (the "
+                             "fleet drill's per-worker fault injection; "
+                             "see sda_tpu.chaos.configure_from_spec)")
+    parser.add_argument("--chaos-seed", type=int, default=0,
+                        help="failpoint schedule seed (--chaos-spec)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     sub = parser.add_subparsers(dest="command", required=True)
     httpd = sub.add_parser("httpd")
@@ -87,6 +109,10 @@ def main(argv=None) -> int:
         service.server.premix_paillier = True
     if args.job_lease is not None:
         service.server.clerking_lease_seconds = args.job_lease
+    if args.chaos_spec:
+        from .. import chaos
+
+        chaos.configure_from_spec(args.chaos_spec, seed=args.chaos_seed)
 
     server = SdaHttpServer(
         service, bind=args.bind,
@@ -96,6 +122,8 @@ def main(argv=None) -> int:
         metrics_endpoint=args.metrics,
         statusz_endpoint=args.statusz,
         trace_log=args.trace,
+        node_id=args.node_id,
+        fleet_peers=args.fleet_peers,
     )
     if args.trace:
         # the span lines ride logging.INFO on their own child logger; make
@@ -106,11 +134,31 @@ def main(argv=None) -> int:
 
         trace_log.setLevel(logging.INFO)
     print(f"sdad listening on {server.address}", flush=True)
+
+    # graceful drain on SIGTERM/SIGINT (the fleet contract): stop
+    # accepting, finish in-flight requests, hand held clerking-job leases
+    # back to the shared store so a peer reissues them immediately, and
+    # report the drain summary as the final stdout line — `sda-fleet` and
+    # the loadgen fleet mode parse it and assert leaked == 0
+    import json
+    import signal
+    import threading
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    server.start_background()
     try:
-        server.serve_forever()
-    except KeyboardInterrupt:
+        stop.wait()
+    except KeyboardInterrupt:  # SIGINT delivered before the handler landed
         pass
-    return 0
+    summary = server.drain(grace_s=args.drain_grace)
+    print(f"sdad drained {json.dumps(summary)}", flush=True)
+    return 0 if summary["leaked"] == 0 else 1
 
 
 if __name__ == "__main__":

@@ -95,6 +95,18 @@ def build_parser() -> argparse.ArgumentParser:
                              "application/x-sda-bin via the server advert), "
                              "json (legacy wire pinned), bin (forced "
                              "binary) (--load)")
+    parser.add_argument("--load-fleet", type=int, metavar="N", default=0,
+                        help="fleet scaling drill: run the SAME fixed-seed "
+                             "load against 1 and then N real `sdad` worker "
+                             "processes over one shared store "
+                             "(--load-store sqlite/jsonfs) and report one "
+                             "BENCH-style scaling record (fleet_nodes, "
+                             "scaling_efficiency) (--load; "
+                             "docs/scaling.md)")
+    parser.add_argument("--load-fleet-baseline", type=int, metavar="N",
+                        default=1,
+                        help="baseline worker count for the scaling "
+                             "record's speedup denominator (--load-fleet)")
     parser.add_argument("--chaos", action="store_true",
                         help="robustness profile: run a full federated "
                              "round over real HTTP with deterministic "
@@ -249,6 +261,40 @@ def _run_load(args) -> int:
         rate = 8.0 if rate is None else rate
         burst = 2.0 if burst is None else burst
     chaos_rate = args.load_chaos_rate or (args.chaos_rate if args.chaos else 0.0)
+    if args.load_fleet:
+        from ..loadgen import run_fleet_scaling
+
+        store = args.load_store
+        if store == "memory":
+            # each OS process would get its own isolated memory store
+            print("note: fleet mode needs a cross-process store; using "
+                  "--load-store sqlite", file=sys.stderr)
+            store = "sqlite"
+        record = run_fleet_scaling(
+            LoadProfile(
+                participants=args.participants,
+                dim=dim,
+                arrivals=args.load_arrivals,
+                target_rps=args.load_rps,
+                concurrency=args.load_concurrency,
+                seed=args.load_seed,
+                store=store,
+                max_inflight=args.load_max_inflight,
+                rate_limit=rate,
+                rate_burst=4.0 if burst is None else burst,
+                chaos_rate=chaos_rate,
+                codec=args.load_codec,
+            ),
+            nodes=args.load_fleet,
+            baseline_nodes=args.load_fleet_baseline,
+        )
+        print(json.dumps(record))
+        ok = (record["exact"] and record["ready"]
+              and not record["client_failures"] and record["leaked"] == 0)
+        if chaos_rate == 0.0:
+            ok = ok and all(r["errors_5xx"] == 0
+                            for r in record["rungs"].values())
+        return 0 if ok else 1
     with tempfile.TemporaryDirectory() as tmp:
         report = run_load(LoadProfile(
             participants=args.participants,

@@ -66,6 +66,7 @@ from ..protocol import (
 )
 from ..protocol import bincodec
 from ..server import SdaServerService, auth_token
+from ..server.routing import NODE_HEADER
 from ..utils import metrics
 from .. import chaos, obs
 from .admission import AdmissionControl
@@ -264,6 +265,12 @@ class _Handler(BaseHTTPRequestHandler):
             # echo the correlation id on EVERY response (reused from the
             # request when the client sent one, minted server-side else)
             self.send_header(obs.REQUEST_ID_HEADER, self._request_id)
+        node_id = getattr(self.server, "node_id", None)
+        if node_id:
+            # fleet plane: name the worker that actually served this
+            # request, so clients/loadgen can verify (advisory) routing
+            # and per-node tallies without scraping anything
+            self.send_header(NODE_HEADER, node_id)
         if self._bin_enabled():
             # codec advert: clients in "auto" mode upgrade the hot routes
             # to application/x-sda-bin after seeing this on ANY response
@@ -314,6 +321,30 @@ class _Handler(BaseHTTPRequestHandler):
         self._body_consumed = False
         self._shed = False
         self._span = None
+        # active-REQUEST census (not connections: an idle keep-alive socket
+        # parked in readline is not in-flight work) — what graceful drain
+        # waits on before releasing leases and closing
+        with self.server.stats_lock:  # type: ignore[attr-defined]
+            self.server.active_requests += 1  # type: ignore[attr-defined]
+        try:
+            self._route_inner(method)
+        finally:
+            with self.server.stats_lock:  # type: ignore[attr-defined]
+                self.server.active_requests -= 1  # type: ignore[attr-defined]
+
+    def _route_inner(self, method: str):
+        if getattr(self.server, "draining", False):
+            # graceful drain: the accept loop is already stopped, but an
+            # established keep-alive connection can still deliver a NEW
+            # request — turn it away before any auth/store work (a lease
+            # granted now would die with the process) and close the
+            # connection so the client reconnects against a live peer
+            self.close_connection = True
+            metrics.count("http.drain.rejected")
+            return self._reply(
+                503, {"error": "draining"},
+                extra_headers={"Connection": "close"}, retry_after=1.0,
+            )
         url = urlparse(self.path)
         path = url.path.rstrip("/")
         query = parse_qs(url.query)
@@ -334,8 +365,11 @@ class _Handler(BaseHTTPRequestHandler):
             if not getattr(self.server, "metrics_enabled", False):
                 return self._reply(404, {"error": "metrics endpoint disabled "
                                                   "(sdad --metrics)"})
+            node_id = getattr(self.server, "node_id", None)
             return self._reply(
-                200, raw=metrics.prometheus_text().encode("utf-8"),
+                200, raw=metrics.prometheus_text(
+                    labels={"node_id": node_id} if node_id else None
+                ).encode("utf-8"),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
         if method == "GET" and path == "/statusz":
@@ -352,10 +386,15 @@ class _Handler(BaseHTTPRequestHandler):
         label = route_label(method, self._route_path)
         parent = obs.parse_traceparent(
             self.headers.get(obs.TRACEPARENT_HEADER))
+        span_attributes = {"http.method": method, "http.route": label,
+                           "request_id": self._request_id}
+        node_id = getattr(self.server, "node_id", None)
+        if node_id:
+            # round timelines show which fleet worker served each hop
+            span_attributes["node_id"] = node_id
         with obs.span(
             f"http.server {label}", parent=parent, kind="server",
-            attributes={"http.method": method, "http.route": label,
-                        "request_id": self._request_id},
+            attributes=span_attributes,
         ) as server_span:
             self._span = server_span
             try:
@@ -598,6 +637,12 @@ class SdaHttpServer:
     ``bin_codec=False`` turns the binary wire codec off (no advert, no
     ``application/x-sda-bin`` parsing) — the old-JSON-server posture the
     mixed-version tests pin.
+
+    ``node_id`` names this worker in a fleet (``sda-fleet``,
+    docs/scaling.md): it rides every response as ``X-SDA-Node``, labels
+    ``/metrics`` samples and ``/statusz``, and lands on every server span
+    so round timelines attribute hops to workers. ``fleet_peers`` records
+    the fleet size as the ``fleet.peers`` gauge.
     """
 
     def __init__(
@@ -612,6 +657,8 @@ class SdaHttpServer:
         statusz_endpoint: bool = False,
         trace_log: bool = False,
         bin_codec: bool = True,
+        node_id: Optional[str] = None,
+        fleet_peers: Optional[int] = None,
     ):
         host, _, port = bind.partition(":")
         self.httpd = ThreadingHTTPServer((host, int(port or 8888)), _Handler)
@@ -619,6 +666,14 @@ class SdaHttpServer:
         self.httpd.sda_service = service  # type: ignore[attr-defined]
         self.httpd.status_counts = {}  # type: ignore[attr-defined]
         self.httpd.stats_lock = threading.Lock()  # type: ignore[attr-defined]
+        self.httpd.active_requests = 0  # type: ignore[attr-defined]
+        self.httpd.draining = False  # type: ignore[attr-defined]
+        self.node_id = node_id
+        self.fleet_peers = fleet_peers
+        self.httpd.node_id = node_id  # type: ignore[attr-defined]
+        service.server.node_id = node_id
+        if fleet_peers is not None:
+            metrics.gauge_set("fleet.peers", fleet_peers)
         self.admission = AdmissionControl(
             max_inflight=max_inflight, rate=rate_limit, burst=rate_burst
         )
@@ -639,6 +694,11 @@ class SdaHttpServer:
         service = self.httpd.sda_service  # type: ignore[attr-defined]
         gauges = metrics.gauge_report("http.inflight")
         return {
+            "node_id": self.node_id,
+            "fleet": {
+                "peers": metrics.gauge_report("fleet.peers").get(
+                    "fleet.peers", 1 if self.node_id else 0),
+            },
             "uptime_s": round(time.time() - self._started_at, 3),
             # backend module name ("memory"/"sqlite"/"jsonfs"/"mongo")
             "store": type(service.server.agents_store).__module__
@@ -647,10 +707,20 @@ class SdaHttpServer:
             "inflight_peak": gauges.get("http.inflight.peak", 0),
             "admission_enabled": self.admission.enabled,
             "requests": self.status_counts,
+            # which wire the peers actually spoke (fleet loadgen reads
+            # the negotiated outcome from here — the counters live in
+            # THIS process, not the driver's)
+            "codec_counters": metrics.counter_report("http.codec.") or {},
             "lease": {
                 "lease_seconds": service.server.clerking_lease_seconds,
                 "counters": metrics.counter_report("server.job."),
             },
+            # contended-idempotency visibility: how often this worker's
+            # snapshot pipeline won, lost, or converged on a peer's freeze
+            "snapshot": metrics.counter_report("server.snapshot.") or {},
+            # fleet drills arm failpoints per worker (sdad --chaos-spec);
+            # the scrape proves the faults actually fired in THIS process
+            "failpoints": chaos.report() or {},
             "devprof": devprof.compile_totals(),
             "hbm": metrics.gauge_report("device.hbm."),
         }
@@ -672,6 +742,50 @@ class SdaHttpServer:
         """Requests served, keyed by HTTP status (observability floor)."""
         with self.httpd.stats_lock:  # type: ignore[attr-defined]
             return dict(self.httpd.status_counts)  # type: ignore[attr-defined]
+
+    @property
+    def active_requests(self) -> int:
+        """Requests currently being handled (idle keep-alive connections
+        excluded — their threads are parked in readline, not working)."""
+        with self.httpd.stats_lock:  # type: ignore[attr-defined]
+            return self.httpd.active_requests  # type: ignore[attr-defined]
+
+    def drain(self, grace_s: float = 10.0) -> dict:
+        """Graceful shutdown (the fleet worker's SIGTERM path): stop
+        accepting, let in-flight requests finish (bounded by ``grace_s``),
+        hand every clerking-job lease this worker still holds back to the
+        shared store so a fleet peer's next poll reissues the work
+        immediately (no visibility-timeout wait), then close. Returns the
+        drain summary — ``leaked`` must be 0 for a clean exit
+        (docs/scaling.md)."""
+        # reject-then-stop: established keep-alive connections can still
+        # deliver new requests after the accept loop stops, so flip the
+        # draining flag FIRST (handlers answer 503 + Connection: close
+        # from here on), then stop the accept/serve loop and wait out the
+        # requests that were already in flight
+        self.httpd.draining = True  # type: ignore[attr-defined]
+        self.httpd.shutdown()  # blocks until the serve loop exits
+        deadline = time.monotonic() + grace_s
+        while self.active_requests and time.monotonic() < deadline:
+            time.sleep(0.02)
+        stranded = self.active_requests
+        service = self.httpd.sda_service  # type: ignore[attr-defined]
+        released = service.server.release_held_leases()
+        self.shutdown()  # joins the (already finished) serve-loop thread
+        if stranded:
+            # a handler still running past the grace window is an
+            # abandoned request — the process exits right after and
+            # kills its daemon thread mid-flight. That IS the leak the
+            # fleet contract gates on.
+            metrics.count("http.shutdown.leaked", stranded)
+        summary = {
+            "node_id": self.node_id,
+            "released_leases": released,
+            "stranded_requests": stranded,
+            "leaked": stranded,
+        }
+        log.info("drained: %s", summary)
+        return summary
 
     @property
     def address(self) -> str:

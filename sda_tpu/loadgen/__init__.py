@@ -8,6 +8,12 @@ server's admission control. Entry points: ``sda-sim --load`` (CLI) and
 ``run_load`` (tests, notebooks). ``docs/load.md`` has the tuning guide.
 """
 
-from .driver import LoadProfile, latency_report_ms, run_load
+from .driver import (
+    LoadProfile,
+    latency_report_ms,
+    run_fleet_scaling,
+    run_load,
+)
 
-__all__ = ["LoadProfile", "latency_report_ms", "run_load"]
+__all__ = ["LoadProfile", "latency_report_ms", "run_fleet_scaling",
+           "run_load"]

@@ -45,7 +45,7 @@ import concurrent.futures
 import random
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import List, Optional
 
 from .. import chaos, obs
@@ -76,6 +76,13 @@ class LoadProfile:
     # wire codec for every client in the swarm: "auto" (upgrade on the
     # server's advert), "json" (legacy wire pinned), "bin" (forced binary)
     codec: str = "auto"
+    # fleet mode: 0 = the classic in-process server; N >= 1 spawns N real
+    # `sdad` OS processes over ONE shared store (sqlite/jsonfs only —
+    # memory cannot be shared across processes) and drives all of them,
+    # routing participants over the consistent-hash ring and the control
+    # plane (snapshot/status/clerk polls) to the aggregation's affinity
+    # node (docs/scaling.md)
+    fleet: int = 0
 
 
 def _percentiles_ms(summary: dict) -> dict:
@@ -133,20 +140,80 @@ def run_load(profile: LoadProfile) -> dict:
     obs.reset_all()
     chaos.reset()
 
-    if profile.store == "memory":
-        service_impl = new_memory_server()
-    elif profile.store == "sqlite":
-        service_impl = new_sqlite_server(profile.store_path or ":memory:")
-    elif profile.store == "jsonfs":
-        if profile.store_path is None:
-            raise ValueError("store='jsonfs' needs store_path")
-        service_impl = new_jsonfs_server(profile.store_path)
-    else:
-        raise ValueError(f"unknown store {profile.store!r}")
-    service_impl.server.clerking_lease_seconds = profile.lease_seconds
+    fleet = None
+    ring = None
 
-    http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
-    http_server.start_background()
+    def _scrape_statusz(address: str) -> dict:
+        import requests
+
+        return requests.get(address + "/statusz", timeout=10.0).json()
+
+    def _fleet_scrapes() -> dict:
+        """One ``/statusz`` document per worker — the fleet's served
+        requests, lease/snapshot counters, and fired failpoints live in
+        the worker processes, not in this one."""
+        return {
+            node: _scrape_statusz(addr)
+            for node, addr in fleet.addresses.items()
+        }
+
+    def _fleet_request_totals(scrapes: dict) -> dict:
+        """Per-node served-request totals from each worker's /statusz
+        (the fleet analog of the in-process ``status_counts`` sum)."""
+        return {
+            node: sum(doc["requests"].values())
+            for node, doc in scrapes.items()
+        }
+
+    if profile.fleet:
+        from ..server.fleet import Fleet
+
+        if profile.store not in ("sqlite", "jsonfs"):
+            raise ValueError(
+                "fleet mode needs a cross-process store "
+                "(store='sqlite' or 'jsonfs'), not "
+                f"{profile.store!r}")
+        if not profile.store_path:
+            raise ValueError("fleet mode needs store_path (the shared "
+                             "database file / directory)")
+        backend = (["--sqlite", profile.store_path]
+                   if profile.store == "sqlite"
+                   else ["--jfs", profile.store_path])
+        # workers are configured up front (flags, not runtime retuning):
+        # lease arbitration + /statusz for per-node tallies always on;
+        # admission and chaos only when the profile asks. The in-process
+        # path arms admission/chaos AFTER setup — fleet setup traffic is
+        # tiny, so whole-run arming keeps the workers stateless.
+        extra = ["--job-lease", str(profile.lease_seconds), "--statusz"]
+        if profile.rate_limit is not None:
+            extra += ["--rate-limit", str(profile.rate_limit),
+                      "--rate-burst", str(profile.rate_burst)]
+        if profile.max_inflight is not None:
+            extra += ["--max-inflight", str(profile.max_inflight)]
+        if profile.chaos_rate > 0.0:
+            extra += ["--chaos-spec",
+                      f"http.server.request=error,rate={profile.chaos_rate}",
+                      "--chaos-seed", str(profile.seed)]
+        fleet = Fleet(profile.fleet, backend, extra_args=extra,
+                      node_prefix="fleet-w")
+        fleet.start()
+        ring = fleet.ring()
+        http_server = None
+    else:
+        if profile.store == "memory":
+            service_impl = new_memory_server()
+        elif profile.store == "sqlite":
+            service_impl = new_sqlite_server(profile.store_path or ":memory:")
+        elif profile.store == "jsonfs":
+            if profile.store_path is None:
+                raise ValueError("store='jsonfs' needs store_path")
+            service_impl = new_jsonfs_server(profile.store_path)
+        else:
+            raise ValueError(f"unknown store {profile.store!r}")
+        service_impl.server.clerking_lease_seconds = profile.lease_seconds
+
+        http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
+        http_server.start_background()
     failures: List[str] = []
     failures_lock = threading.Lock()
     try:
@@ -157,21 +224,42 @@ def run_load(profile: LoadProfile) -> dict:
             # worker threads have no thread-local context: pass the round
             # context explicitly so every participant span joins the trace
             round_ctx = round_span.context
-            proxy = SdaHttpClient(
-                http_server.address,
-                token="load-drill-token",
-                # generous retry budget: under the overload profile EVERY
-                # participant is expected to be shed at least once and must
-                # converge through Retry-After hints within the deadline
-                max_retries=16, backoff_base=0.01, backoff_cap=0.25,
-                deadline=profile.timeout_s,
-                codec=profile.codec,
-            )
+
+            def _new_proxy(address: str) -> SdaHttpClient:
+                return SdaHttpClient(
+                    address,
+                    token="load-drill-token",
+                    # generous retry budget: under the overload profile
+                    # EVERY participant is expected to be shed at least
+                    # once and must converge through Retry-After hints
+                    # within the deadline
+                    max_retries=16, backoff_base=0.01, backoff_cap=0.25,
+                    deadline=profile.timeout_s,
+                    codec=profile.codec,
+                )
+
+            if fleet is not None:
+                # one transport per worker; the ring maps any stable key
+                # (agent id, aggregation id) to its affinity node — purely
+                # advisory, every worker serves every route correctly
+                node_proxies = {node: _new_proxy(addr)
+                                for node, addr in fleet.addresses.items()}
+
+                def _proxy_for(key) -> SdaHttpClient:
+                    return node_proxies[ring.node_for(str(key))]
+            else:
+                single_proxy = _new_proxy(http_server.address)
+
+                def _proxy_for(key) -> SdaHttpClient:
+                    return single_proxy
 
             def new_client():
                 keystore = MemoryKeystore()
                 agent = SdaClient.new_agent(keystore)
-                return SdaClient(agent, keystore, proxy)
+                # agents ride their own affinity node: participants spread
+                # over the whole fleet, each clerk's job polling
+                # concentrates where its leases live (docs/scaling.md)
+                return SdaClient(agent, keystore, _proxy_for(agent.id))
 
             # -- setup (unthrottled: admission armed after) ---------------
             recipient = new_client()
@@ -198,20 +286,27 @@ def run_load(profile: LoadProfile) -> dict:
                 recipient_encryption_scheme=SodiumEncryption(),
                 committee_encryption_scheme=SodiumEncryption(),
             )
+            if fleet is not None:
+                # the round's control plane (snapshot POST, status polls,
+                # reveal) rides the aggregation's affinity node from here
+                recipient.service = _proxy_for(agg.id)
             recipient.upload_aggregation(agg)
             recipient.begin_aggregation(agg.id)
             committee = recipient.service.get_committee(recipient.agent, agg.id)
             clerks = [candidates[cid] for cid, _ in committee.clerks_and_keys]
 
             # -- arm admission + chaos, then open the floodgates ----------
-            http_server.configure_admission(
-                max_inflight=profile.max_inflight,
-                rate_limit=profile.rate_limit,
-                rate_burst=profile.rate_burst,
-            )
-            if profile.chaos_rate > 0.0:
-                chaos.configure("http.server.request", error=True,
-                                rate=profile.chaos_rate, seed=profile.seed)
+            # (fleet workers were armed at spawn via CLI flags — admission
+            # and failpoints live in THEIR processes, not this one)
+            if fleet is None:
+                http_server.configure_admission(
+                    max_inflight=profile.max_inflight,
+                    rate_limit=profile.rate_limit,
+                    rate_burst=profile.rate_burst,
+                )
+                if profile.chaos_rate > 0.0:
+                    chaos.configure("http.server.request", error=True,
+                                    rate=profile.chaos_rate, seed=profile.seed)
 
             rng = np.random.default_rng(profile.seed)
             inputs = rng.integers(0, scheme.prime_modulus,
@@ -253,7 +348,15 @@ def run_load(profile: LoadProfile) -> dict:
                         return False
 
             arrival_rng = random.Random(profile.seed)
-            setup_requests = sum(http_server.status_counts.values())
+            # ONE scrape round per measurement boundary: the per-status
+            # merge and the per-node totals read the same documents
+            if fleet is not None:
+                scrapes = _fleet_scrapes()
+                per_node_setup = _fleet_request_totals(scrapes)
+                setup_requests = sum(per_node_setup.values())
+            else:
+                setup_requests = sum(http_server.status_counts.values())
+                per_node_setup = None
             t_load0 = time.perf_counter()
             with concurrent.futures.ThreadPoolExecutor(
                 max_workers=max(1, profile.concurrency)
@@ -282,8 +385,22 @@ def run_load(profile: LoadProfile) -> dict:
             load_elapsed = time.perf_counter() - t_load0
             # the headline RPS covers ONLY the participant window: snapshot
             # before the close phase adds clerk polling traffic
-            load_requests = (sum(http_server.status_counts.values())
-                             - setup_requests)
+            per_node_load = None
+            if fleet is not None:
+                scrapes = _fleet_scrapes()
+                # each worker served exactly ONE /statusz inside the
+                # window — the setup-boundary scrape (a scrape's own
+                # request is only counted after its document is built,
+                # so the end scrape isn't in its own doc). Subtract it:
+                # the tallies must be about real load traffic
+                per_node_load = {
+                    node: max(0, total - per_node_setup.get(node, 0) - 1)
+                    for node, total in _fleet_request_totals(scrapes).items()
+                }
+                load_requests = sum(per_node_load.values())
+            else:
+                load_requests = (sum(http_server.status_counts.values())
+                                 - setup_requests)
 
             # -- close the round: snapshot, clerking, reveal --------------
             recipient.end_aggregation(agg.id)
@@ -324,11 +441,41 @@ def run_load(profile: LoadProfile) -> dict:
         chaos.reset()
         total_elapsed = time.perf_counter() - t_load0 \
             if "t_load0" in locals() else 0.0
-        status_counts = http_server.status_counts
-        http_server.shutdown()
+        if fleet is not None:
+            # last scrape BEFORE the drain: the workers' served-request,
+            # lease, snapshot, and failpoint state dies with them
+            try:
+                final_scrapes = _fleet_scrapes()
+            except Exception:
+                final_scrapes = {}
+            status_counts = {}
+            for doc in final_scrapes.values():
+                for code, count in doc["requests"].items():
+                    code = int(code)
+                    status_counts[code] = status_counts.get(code, 0) + count
+            worker_failpoints = {
+                node: doc.get("failpoints") or {}
+                for node, doc in final_scrapes.items()
+                if doc.get("failpoints")
+            }
+            if worker_failpoints:
+                failpoint_report = worker_failpoints
+            drain_summaries = fleet.stop()
+        else:
+            status_counts = http_server.status_counts
+            http_server.shutdown()
 
     counters = metrics.counter_report()
     codec_counters = metrics.counter_report("http.codec.") or None
+    if fleet is not None:
+        # the codec counters are stamped server-side, i.e. in the worker
+        # processes: merge their final scrapes so the negotiated-wire
+        # field below names what the fleet actually spoke
+        merged_codec: dict = {}
+        for doc in final_scrapes.values():
+            for name, count in (doc.get("codec_counters") or {}).items():
+                merged_codec[name] = merged_codec.get(name, 0) + count
+        codec_counters = merged_codec or None
     lag_summary = metrics.histogram_report("load.lag").get("load.lag")
     clerk_job_summary = metrics.histogram_report("clerk.job.").get(
         "clerk.job.seconds")
@@ -338,6 +485,7 @@ def run_load(profile: LoadProfile) -> dict:
     report = {
         "mode": (f"loadgen {profile.arrivals}-loop "
                  f"({profile.store} store"
+                 + (f", fleet x{profile.fleet}" if profile.fleet else "")
                  + (", overload profile" if profile.rate_limit is not None
                     or profile.max_inflight is not None else "")
                  + (f", chaos rate {profile.chaos_rate}"
@@ -406,4 +554,108 @@ def run_load(profile: LoadProfile) -> dict:
                              "server.participation."))
         } or None,
     }
+    if fleet is not None:
+        report["fleet_nodes"] = profile.fleet
+        report["fleet"] = {
+            # per-worker view, scraped from each /statusz just before the
+            # drain: served requests (whole run + load window), job-lease
+            # and snapshot-contention counters, admission peaks
+            "nodes": {
+                node: {
+                    "address": fleet.addresses.get(node),
+                    "requests": sum(
+                        (final_scrapes.get(node, {}).get("requests") or {})
+                        .values()),
+                    "load_requests": (per_node_load or {}).get(node),
+                    "load_rps": round(
+                        (per_node_load or {}).get(node, 0) / load_elapsed, 1)
+                    if load_elapsed else 0.0,
+                    "inflight_peak": final_scrapes.get(node, {})
+                    .get("inflight_peak"),
+                    "jobs": (final_scrapes.get(node, {}).get("lease") or {})
+                    .get("counters"),
+                    "snapshot": final_scrapes.get(node, {}).get("snapshot"),
+                }
+                for node in fleet.node_ids
+            },
+            "drain": drain_summaries,
+            "leaked": sum(int(s.get("leaked", 0) or 0)
+                          for s in drain_summaries),
+            "released_leases": sum(int(s.get("released_leases", 0) or 0)
+                                   for s in drain_summaries),
+        }
     return report
+
+
+def run_fleet_scaling(profile: LoadProfile, nodes: int,
+                      baseline_nodes: int = 1) -> dict:
+    """The scaling drill: the SAME fixed-seed load twice — once against
+    ``baseline_nodes`` worker process(es), once against ``nodes`` — each
+    over a FRESH copy of the shared store, reported as one BENCH-style
+    record the regression gate understands (``sda-bench --check``:
+    ``fleet_nodes`` joins the comparability key, ``scaling_efficiency``
+    rides as an advisory metric).
+
+    ``scaling_efficiency`` is measured speedup over ideal speedup:
+    ``(rps_N / rps_baseline) / (N / baseline)`` — 1.0 is perfectly linear.
+    The record carries ``host_cores`` because the ceiling is physical:
+    N Python worker processes cannot scale past the cores that exist
+    (docs/scaling.md discusses reading the number honestly).
+    """
+    import os
+    import tempfile
+
+    if profile.store not in ("sqlite", "jsonfs"):
+        raise ValueError("the scaling drill needs a cross-process store "
+                         "(store='sqlite' or 'jsonfs')")
+    if nodes < 1 or baseline_nodes < 1 or nodes < baseline_nodes:
+        raise ValueError("need nodes >= baseline_nodes >= 1")
+
+    reports = {}
+    for n in dict.fromkeys((baseline_nodes, nodes)):
+        with tempfile.TemporaryDirectory() as tmp:
+            reports[n] = run_load(replace(
+                profile, fleet=n, store_path=os.path.join(tmp, "store")))
+    base, top = reports[baseline_nodes], reports[nodes]
+    speedup = (top["sustained_rps"] / base["sustained_rps"]
+               if base["sustained_rps"] else 0.0)
+    ideal = nodes / baseline_nodes
+    record = {
+        "metric": (f"fleet sustained RPS ({profile.arrivals}-loop, "
+                   f"{profile.participants} participants, dim "
+                   f"{profile.dim}, {profile.store} store)"),
+        "value": top["sustained_rps"],
+        "unit": "requests/sec",
+        "platform": "cpu",  # the serving plane is a host-tier workload
+        "host_cores": os.cpu_count(),
+        "codec": top["codec"],
+        "seed": profile.seed,
+        "chaos_rate": profile.chaos_rate,
+        "fleet_nodes": nodes,
+        "baseline_nodes": baseline_nodes,
+        "baseline_rps": base["sustained_rps"],
+        "speedup": round(speedup, 3),
+        "ideal_speedup": round(ideal, 3),
+        "scaling_efficiency": round(speedup / ideal, 3) if ideal else 0.0,
+        "per_node_load_rps": {
+            node: stats["load_rps"]
+            for node, stats in top["fleet"]["nodes"].items()
+        },
+        # the verdict is conjunctive: BOTH rungs must close the round
+        # bit-exactly with zero leaked requests and zero lost admitted
+        # participations — scaling that corrupts is not scaling
+        "exact": bool(base["exact"] and top["exact"]),
+        "ready": bool(base["ready"] and top["ready"]),
+        "client_failures": base["client_failures"] + top["client_failures"],
+        "leaked": base["fleet"]["leaked"] + top["fleet"]["leaked"],
+        "rungs": {
+            str(n): {
+                key: rep.get(key)
+                for key in ("sustained_rps", "load_seconds", "round_seconds",
+                            "load_requests", "requests", "completed",
+                            "shed_429", "errors_5xx", "exact", "ready")
+            }
+            for n, rep in reports.items()
+        },
+    }
+    return record

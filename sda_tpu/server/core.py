@@ -12,6 +12,7 @@ framework's checkpoint/resume story (SURVEY.md §5.4).
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 from ..protocol import (
@@ -76,10 +77,20 @@ class SdaServer:
         #: lease expires without a result. None keeps the reference's
         #: visible-poll semantics (the job is returned on every poll).
         self.clerking_lease_seconds: Optional[float] = None
-        # serializes the snapshot pipeline: a timed-out client retrying a
-        # slow snapshot POST must queue behind the original, not race its
-        # freeze/enqueue (snapshot.py relies on this for first-write-wins)
+        # serializes the snapshot pipeline WITHIN this process: a timed-out
+        # client retrying a slow snapshot POST must queue behind the
+        # original, not race its freeze/enqueue. ACROSS processes the
+        # store-level single-winner freeze/record inserts arbitrate
+        # (snapshot.py, contended-idempotency contract)
         self._snapshot_lock = threading.Lock()
+        #: node identity in a fleet (sda_tpu/server/fleet.py); None when
+        #: running solo. Flows into span attributes, /statusz, /metrics
+        #: labels and the X-SDA-Node response header.
+        self.node_id: Optional[str] = None
+        # leases THIS worker granted and has not yet seen a result for —
+        # what graceful drain hands back to the fleet (release_held_leases)
+        self._granted_leases: dict = {}
+        self._granted_lock = threading.Lock()
 
     # -- health ------------------------------------------------------------
     def ping(self) -> Pong:
@@ -186,9 +197,23 @@ class SdaServer:
                 )
                 job = None
                 if leased is not None:
-                    job, _expires = leased
+                    job, expires = leased
                     poll_span.set_attribute("leased", True)
                     metrics.count("server.job.leased")
+                    with self._granted_lock:
+                        if len(self._granted_leases) >= 256:
+                            # opportunistic sweep: a result posted via a
+                            # PEER worker (or a lapsed lease a peer
+                            # reissued) never comes back through this
+                            # worker's create_result, so lapsed entries
+                            # would otherwise accumulate forever
+                            now = time.time()
+                            self._granted_leases = {
+                                j: ce
+                                for j, ce in self._granted_leases.items()
+                                if ce[1] > now
+                            }
+                        self._granted_leases[job.id] = (clerk, expires)
             else:
                 job = self.clerking_job_store.poll_clerking_job(clerk)
             if job is not None:
@@ -205,7 +230,37 @@ class SdaServer:
         with obs.span("server.create_result",
                       attributes={"job": str(result.job)}):
             self.clerking_job_store.create_clerking_result(result)
+        with self._granted_lock:
+            self._granted_leases.pop(result.job, None)
         metrics.count("server.clerking_result.created")
+
+    def release_held_leases(self) -> int:
+        """Graceful-drain step: hand every clerking-job lease this worker
+        granted (and has no result for yet) back to the shared store, so
+        a fleet peer's next poll reissues the job immediately instead of
+        waiting out the visibility timeout. Returns how many leases were
+        actually released (already-expired or just-completed ones are
+        not)."""
+        with self._granted_lock:
+            held = list(self._granted_leases.items())
+            self._granted_leases.clear()
+        released = 0
+        now = time.time()
+        for job_id, (clerk, expires) in held:
+            if expires <= now:
+                # lapsed: a peer may already hold a fresh lease on this
+                # job — it is not ours to release anymore
+                continue
+            try:
+                if self.clerking_job_store.release_clerking_job_lease(
+                    clerk, job_id, expires=expires
+                ):
+                    released += 1
+            except Exception:  # drain must not die on one store hiccup
+                continue
+        if released:
+            metrics.count("server.job.lease_released_on_drain", released)
+        return released
 
     def get_snapshot_result(
         self, aggregation: AggregationId, snapshot: SnapshotId

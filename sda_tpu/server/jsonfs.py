@@ -27,6 +27,8 @@ queue -> done moves on result creation (jfs_stores/clerking_jobs.rs:36-59).
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import json
 import os
 import tempfile
@@ -78,6 +80,30 @@ def _write_json(path: Path, obj) -> None:
         raise
 
 
+def _write_json_new(path: Path, obj) -> bool:
+    """Create-if-absent, atomically even across OS processes: the payload
+    lands in a temp file, then ``os.link`` publishes it — link(2) fails
+    with EEXIST when the destination already exists, so exactly one of N
+    racing writers wins and the losers see the winner's complete file
+    (never a partial write). Returns whether THIS call created the file —
+    the jsonfs arbiter for the contended-idempotency contract."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f)
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 def _read_json(path: Path):
     if not path.exists():
         return None
@@ -99,6 +125,24 @@ class _FsStore(BaseStore):
     def ping(self) -> None:
         if not self.root.is_dir():
             raise NotFound(f"store root {self.root} missing")
+
+    @contextlib.contextmanager
+    def _dir_lock(self, directory: Path):
+        """Cross-PROCESS mutual exclusion over ``directory`` (flock on a
+        dot-file inside it, so ``_ids_in`` never sees it). The in-process
+        ``_lock`` only serializes threads; read-check-write sequences
+        that must be atomic across fleet worker processes — the lease
+        grant/release plane — take this too. Single-file publishes don't
+        need it: ``os.link`` arbitration already is cross-process."""
+        directory.mkdir(parents=True, exist_ok=True)
+        fd = os.open(str(directory / ".dirlock"), os.O_CREAT | os.O_RDWR,
+                     0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
 
 
 class JsonAuthTokensStore(_FsStore, AuthTokensStore):
@@ -219,8 +263,10 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
 
     def create_snapshot(self, snapshot):
         chaos.fail("store.create_snapshot")
+        # conditional create: link(2) beats N racing server processes
+        # down to one winner; the record file never changes once present
         with self._lock:
-            _write_json(
+            return _write_json_new(
                 self.root / "snapshots" / str(snapshot.aggregation) / f"{snapshot.id}.json",
                 snapshot.to_obj(),
             )
@@ -243,9 +289,14 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
             return len(_ids_in(self.root / "participations" / str(aggregation)))
 
     def snapshot_participations(self, aggregation, snapshot):
+        # single-winner freeze: the frozen-id file IS both the marker and
+        # the set, created atomically with link(2) — a loser returning
+        # False can immediately read the winner's complete id list
         with self._lock:
             part_ids = _ids_in(self.root / "participations" / str(aggregation))
-            _write_json(self.root / "snapshot_parts" / f"{snapshot}.json", part_ids)
+            return _write_json_new(
+                self.root / "snapshot_parts" / f"{snapshot}.json", part_ids
+            )
 
     def has_snapshot_freeze(self, aggregation, snapshot):
         with self._lock:
@@ -334,20 +385,43 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
     def lease_clerking_job(self, clerk, lease_seconds, now=None):
         chaos.fail("store.poll_clerking_job")
         now = time.time() if now is None else now
-        with self._lock:
+        with self._lock, self._dir_lock(self.root / "queue" / str(clerk)):
             qdir = self.root / "queue" / str(clerk)
             # lease files are dot-prefixed so _ids_in never mistakes one
-            # for a queued job; they survive restarts like everything else
+            # for a queued job; they survive restarts like everything else.
+            # The dir lock makes the expiry-check -> lease-stamp sequence
+            # atomic across fleet worker processes: two sdad's polling one
+            # clerk identity cannot both stamp the same job
             for job_id in _ids_in(qdir):
                 lease = _read_json(qdir / f".lease-{job_id}.json")
                 if lease is not None and lease["expires"] > now:
                     continue  # actively leased by another worker
+                obj = _read_json(qdir / f"{job_id}.json")
+                if obj is None:
+                    continue  # done-move by a peer since the listing
                 if lease is not None:
                     metrics.count("server.job.reissued")
                 expires = now + lease_seconds
                 _write_json(qdir / f".lease-{job_id}.json", {"expires": expires})
-                return ClerkingJob.from_obj(_read_json(qdir / f"{job_id}.json")), expires
+                return ClerkingJob.from_obj(obj), expires
             return None
+
+    def release_clerking_job_lease(self, clerk, job, expires=None):
+        # graceful drain: unlink the dot-lease file so any process's next
+        # poll sees the job unleased; done jobs have left the queue dir.
+        # Compare-and-release on the expiry instant: a lapsed lease
+        # re-granted to a peer carries a NEW expiry and is left alone
+        with self._lock, self._dir_lock(self.root / "queue" / str(clerk)):
+            qdir = self.root / "queue" / str(clerk)
+            if not (qdir / f"{job}.json").exists():
+                return False
+            lease_path = qdir / f".lease-{job}.json"
+            lease = _read_json(lease_path)
+            if lease is None or (expires is not None
+                                 and lease["expires"] != expires):
+                return False
+            lease_path.unlink(missing_ok=True)
+            return True
 
     def get_clerking_job(self, clerk, job):
         with self._lock:

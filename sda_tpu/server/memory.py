@@ -166,8 +166,14 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
 
     def create_snapshot(self, snapshot):
         chaos.fail("store.create_snapshot")
+        # conditional insert: first writer wins, the record never changes
+        # after it exists (contended-idempotency contract, stores.py)
         with self._lock:
-            self._snapshots[snapshot.aggregation][snapshot.id] = snapshot
+            snapshots = self._snapshots[snapshot.aggregation]
+            if snapshot.id in snapshots:
+                return False
+            snapshots[snapshot.id] = snapshot
+            return True
 
     def list_snapshots(self, aggregation):
         with self._lock:
@@ -182,10 +188,16 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
             return len(self._participations.get(aggregation, OrderedDict()))
 
     def snapshot_participations(self, aggregation, snapshot):
+        # single-winner: the dict insert under the lock is the arbiter;
+        # a loser returns False and the winner's frozen set is already
+        # readable (same lock serializes freeze and read)
         with self._lock:
+            if snapshot in self._snapshot_parts:
+                return False
             self._snapshot_parts[snapshot] = list(
                 self._participations.get(aggregation, OrderedDict())
             )
+            return True
 
     def has_snapshot_freeze(self, aggregation, snapshot):
         with self._lock:
@@ -254,6 +266,20 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
                 self._leases[job.id] = expires
                 return job, expires
             return None
+
+    def release_clerking_job_lease(self, clerk, job, expires=None):
+        # graceful drain: drop the visibility timeout so the next poller
+        # (another worker of this clerk) gets the job immediately —
+        # compare-and-release: a lapsed lease re-granted to a peer (new
+        # expiry) is the peer's to release, not ours
+        with self._lock:
+            if job not in self._queues.get(clerk, OrderedDict()):
+                return False  # done (or never enqueued): nothing to release
+            current = self._leases.get(job)
+            if current is None or (expires is not None and current != expires):
+                return False
+            del self._leases[job]
+            return True
 
     def get_clerking_job(self, clerk, job):
         with self._lock:

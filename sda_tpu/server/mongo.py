@@ -208,15 +208,19 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
 
     def create_snapshot(self, snapshot):
         chaos.fail("store.create_snapshot")
-        self.db.snapshots.replace_one(
+        # conditional insert via $setOnInsert upsert — Mongo's atomic
+        # create-if-absent; upserted_id says whether THIS call won the
+        # race (contended-idempotency contract, stores.py)
+        result = self.db.snapshots.update_one(
             {"_id": str(snapshot.id)},
-            {
+            {"$setOnInsert": {
                 "_id": str(snapshot.id),
                 "aggregation": str(snapshot.aggregation),
                 "doc": snapshot.to_obj(),
-            },
+            }},
             upsert=True,
         )
+        return result.upserted_id is not None
 
     def list_snapshots(self, aggregation):
         return [
@@ -237,35 +241,60 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         )
 
     def snapshot_participations(self, aggregation, snapshot):
-        # the reference's $addToSet freeze (aggregations.rs:132-142); the
-        # marker doc records the freeze durably even when the set is empty.
-        # Marker LAST is the correct commit point: jobs/masks are only
-        # built after the freeze returns, so a crash between the two
-        # writes leaves nothing that consumed the half-frozen set — the
-        # replay re-runs the idempotent $addToSet (possibly widening the
-        # set) and every downstream consumer sees that one final set.
-        self.db.participations.update_many(
-            {"aggregation": str(aggregation)},
-            {"$addToSet": {"snapshots": str(snapshot)}},
+        # single-winner freeze in ONE atomic document write: the marker
+        # doc itself carries the frozen participation-id list, installed
+        # with a $setOnInsert upsert — Mongo's create-if-absent — so two
+        # racing server processes cannot install different sets and the
+        # loser (upserted_id None) can read the winner's complete list
+        # the moment this returns. This replaces the reference's
+        # two-write $addToSet + marker freeze (aggregations.rs:132-142),
+        # which was crash-replay-safe but not contended-safe: two
+        # processes interleaving $addToSet sweeps could freeze different
+        # supersets. Legacy $addToSet-frozen data (marker without "ids")
+        # still reads through the snapshots-array fallback below.
+        part_ids = sorted(
+            d["_id"]
+            for d in self.db.participations.find(
+                {"aggregation": str(aggregation)})
         )
-        self.db.snapshot_freezes.replace_one(
-            {"_id": str(snapshot)}, {"_id": str(snapshot)}, upsert=True
+        result = self.db.snapshot_freezes.update_one(
+            {"_id": str(snapshot)},
+            {"$setOnInsert": {"_id": str(snapshot), "ids": part_ids}},
+            upsert=True,
         )
+        return result.upserted_id is not None
 
     def has_snapshot_freeze(self, aggregation, snapshot):
         return self.db.snapshot_freezes.find_one({"_id": str(snapshot)}) is not None
 
+    def _frozen_ids(self, snapshot) -> Optional[List[str]]:
+        """The marker doc's frozen id list, or None for pre-fleet data
+        frozen via $addToSet (read those through the snapshots array)."""
+        marker = self.db.snapshot_freezes.find_one({"_id": str(snapshot)})
+        if marker is None or "ids" not in marker:
+            return None
+        return marker["ids"]
+
     def count_participations_snapshot(self, aggregation, snapshot):
+        ids = self._frozen_ids(snapshot)
+        if ids is not None:
+            return len(ids)
         return self.db.participations.count_documents(
             {"aggregation": str(aggregation), "snapshots": str(snapshot)}
         )
 
     def iter_snapped_participations(self, aggregation, snapshot):
-        return [
-            Participation.from_obj(d["doc"])
-            for d in self.db.participations.find(
+        ids = self._frozen_ids(snapshot)
+        if ids is not None:
+            cursor = self.db.participations.find(
+                {"aggregation": str(aggregation), "_id": {"$in": ids}}
+            )
+        else:  # legacy $addToSet freeze
+            cursor = self.db.participations.find(
                 {"aggregation": str(aggregation), "snapshots": str(snapshot)}
-            ).sort("_id", 1)
+            )
+        return [
+            Participation.from_obj(d["doc"]) for d in cursor.sort("_id", 1)
         ]
 
     def create_snapshot_mask(self, snapshot, mask):
@@ -370,6 +399,19 @@ class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
         if doc.get("leased_until") is not None:
             metrics.count("server.job.reissued")
         return ClerkingJob.from_obj(doc["doc"]), expires
+
+    def release_clerking_job_lease(self, clerk, job, expires=None):
+        # graceful drain: zero the visibility timeout on a still-undone
+        # job so any process's next lease poll picks it up immediately.
+        # Compare-and-release: with `expires` only the exact granted
+        # lease matches — a reissued lease (new leased_until) is the
+        # peer's to keep
+        result = self.db.clerking_jobs.update_one(
+            {"_id": str(job), "clerk": str(clerk), "done": False,
+             "leased_until": {"$gt": 0} if expires is None else expires},
+            {"$set": {"leased_until": 0}},
+        )
+        return result.matched_count > 0
 
     def get_clerking_job(self, clerk, job):
         doc = self.db.clerking_jobs.find_one({"_id": str(job), "clerk": str(clerk)})

@@ -104,16 +104,18 @@ def _snapshot_locked(server, snap: Snapshot) -> bool:
         return False
     log.debug("snapshot %s: freezing participations", snap.id)
     with timed_phase("server.snapshot_freeze"):
-        # first-write-wins: a crash-replay (record not yet committed, but
-        # jobs possibly enqueued and even clerked) must re-use the
-        # ORIGINAL frozen set — re-freezing after a late participation
+        # first-write-wins, now store-arbitrated: snapshot_participations
+        # is single-winner even across competing server processes, so a
+        # crash-replay (record not yet committed, jobs possibly enqueued
+        # and even clerked) AND a concurrent peer's pipeline both re-use
+        # the ORIGINAL frozen set — re-freezing after a late participation
         # would mix share generations across clerk columns
-        if not server.aggregation_store.has_snapshot_freeze(
+        if not server.aggregation_store.snapshot_participations(
             snap.aggregation, snap.id
         ):
-            server.aggregation_store.snapshot_participations(
-                snap.aggregation, snap.id
-            )
+            log.debug("snapshot %s: freeze already installed (replay or "
+                      "competing worker); converging on it", snap.id)
+            metrics.count("server.snapshot.freeze_converged")
 
     committee = server.get_committee(snap.aggregation)
     if committee is None:
@@ -176,8 +178,16 @@ def _snapshot_locked(server, snap: Snapshot) -> bool:
     # check above can safely short-circuit a retried create. A crash
     # mid-pipeline leaves no record and the retry re-runs everything —
     # job ids are deterministic, so the re-run upserts instead of
-    # duplicating.
-    server.aggregation_store.create_snapshot(snap)
+    # duplicating. The insert is single-winner across competing server
+    # processes (store-level conditional insert): when a peer's pipeline
+    # commits first, OUR pipeline has already upserted the exact same
+    # uuid5(snapshot, clerk) job set, so losing is convergence — report
+    # not-created and leave the winner's record untouched.
+    if not server.aggregation_store.create_snapshot(snap):
+        log.debug("snapshot %s: lost the record race to a competing "
+                  "worker (identical job set already enqueued)", snap.id)
+        metrics.count("server.snapshot.contended")
+        return False
 
     log.debug("snapshot %s: done", snap.id)
     return True

@@ -25,7 +25,9 @@ backend (SURVEY.md §5.4).
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 import sqlite3
 import threading
 import time
@@ -101,15 +103,53 @@ CREATE TABLE IF NOT EXISTS clerking_results (
 
 
 class SqliteDb:
-    """Shared single-writer handle; ``":memory:"`` works for tests."""
+    """Shared per-process handle; ``":memory:"`` works for tests.
 
-    def __init__(self, path):
+    One database file can be shared by SEVERAL OS processes (the fleet
+    plane, ``sda_tpu/server/fleet.py``): WAL lets readers proceed under a
+    writer, ``busy_timeout`` makes competing writers queue instead of
+    throwing ``database is locked``, and every multi-statement write runs
+    inside an explicit ``BEGIN IMMEDIATE`` transaction so it takes the
+    write lock up front — no deferred-transaction upgrade deadlocks
+    between two processes mid-write. Within one process the ``lock``
+    RLock serializes threads over the single connection.
+    """
+
+    def __init__(self, path, busy_timeout_s: float = None):
         self.path = str(path)
         self.lock = threading.RLock()
-        self.conn = sqlite3.connect(self.path, check_same_thread=False)
-        with self.lock, self.conn:
+        if busy_timeout_s is None:
+            busy_timeout_s = float(os.environ.get("SDA_SQLITE_BUSY_MS", 10000)) / 1e3
+        # isolation_level=None = autocommit: single statements commit
+        # themselves; transactions are explicit BEGIN IMMEDIATE via
+        # immediate() (python's implicit deferred transactions would
+        # upgrade read->write locks mid-transaction, the classic
+        # two-process SQLITE_BUSY deadlock)
+        self.conn = sqlite3.connect(
+            self.path, check_same_thread=False, isolation_level=None
+        )
+        with self.lock:
+            self.conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_s * 1e3)}")
             if self.path != ":memory:":
-                self.conn.execute("PRAGMA journal_mode=WAL")
+                # the rollback->WAL transition needs an exclusive lock and
+                # does NOT always consult the busy handler (it returns
+                # SQLITE_BUSY straight away mid-transition) — N fleet
+                # workers opening one fresh database file race exactly
+                # that, so retry by hand under the same time budget
+                deadline = time.monotonic() + busy_timeout_s
+                while True:
+                    try:
+                        self.conn.execute("PRAGMA journal_mode=WAL")
+                        break
+                    except sqlite3.OperationalError as e:
+                        if "locked" not in str(e) \
+                                or time.monotonic() >= deadline:
+                            raise
+                        time.sleep(0.05)
+                # WAL's standard durability pairing: fsync on checkpoint,
+                # not on every commit — the cross-process write path is
+                # hot (every participation is one commit)
+                self.conn.execute("PRAGMA synchronous=NORMAL")
             self.conn.executescript(_SCHEMA)
             # migrate pre-lease databases: CREATE IF NOT EXISTS won't add
             # the column to an existing clerking_jobs table
@@ -121,6 +161,22 @@ class SqliteDb:
                     "ALTER TABLE clerking_jobs "
                     "ADD COLUMN leased_until REAL NOT NULL DEFAULT 0"
                 )
+
+    @contextlib.contextmanager
+    def immediate(self):
+        """One multi-statement write as a single ``BEGIN IMMEDIATE``
+        transaction: the write lock is taken at BEGIN (queueing behind
+        other processes under busy_timeout), statements run, COMMIT
+        publishes all of them atomically."""
+        with self.lock:
+            self.conn.execute("BEGIN IMMEDIATE")
+            try:
+                yield self.conn
+            except BaseException:
+                self.conn.execute("ROLLBACK")
+                raise
+            else:
+                self.conn.execute("COMMIT")
 
     def ping(self) -> None:
         with self.lock:
@@ -144,8 +200,9 @@ class _SqliteStore(BaseStore):
             return self.db.conn.execute(sql, args).fetchall()
 
     def _exec(self, sql: str, args=()):
-        with self.db.lock, self.db.conn:
-            self.db.conn.execute(sql, args)
+        # autocommit connection: a single statement is its own transaction
+        with self.db.lock:
+            return self.db.conn.execute(sql, args)
 
 
 class SqliteAuthTokensStore(_SqliteStore, AuthTokensStore):
@@ -246,7 +303,7 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
 
     def delete_aggregation(self, aggregation):
         agg = str(aggregation)
-        with self.db.lock, self.db.conn:
+        with self.db.immediate():
             for table in ("snapshot_parts", "snapshot_masks", "snapshot_freezes"):
                 self.db.conn.execute(
                     f"DELETE FROM {table} WHERE snapshot IN "
@@ -275,7 +332,7 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
 
     def create_participation(self, participation):
         chaos.fail("store.create_participation")
-        with self.db.lock, self.db.conn:
+        with self.db.immediate():
             exists = self.db.conn.execute(
                 "SELECT 1 FROM aggregations WHERE id = ?",
                 (str(participation.aggregation),),
@@ -294,15 +351,20 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
 
     def create_snapshot(self, snapshot):
         chaos.fail("store.create_snapshot")
-        self._exec(
-            "INSERT INTO snapshots (id, aggregation, doc) VALUES (?, ?, ?) "
-            "ON CONFLICT (aggregation, id) DO UPDATE SET doc = excluded.doc",
+        # conditional insert (single-winner across competing server
+        # processes): OR IGNORE makes the existing row win and rowcount
+        # says whether THIS statement inserted — the contended-idempotency
+        # commit point (stores.py contract)
+        cursor = self._exec(
+            "INSERT OR IGNORE INTO snapshots (id, aggregation, doc) "
+            "VALUES (?, ?, ?)",
             (
                 str(snapshot.id),
                 str(snapshot.aggregation),
                 json.dumps(snapshot.to_obj()),
             ),
         )
+        return cursor.rowcount > 0
 
     def list_snapshots(self, aggregation):
         rows = self._all(
@@ -326,19 +388,25 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
         return row[0]
 
     def snapshot_participations(self, aggregation, snapshot):
-        # the $addToSet moment: freeze exactly the rows present now; the
-        # marker row (same transaction) records the freeze durably even
-        # when the frozen set is empty
-        with self.db.lock, self.db.conn:
+        # the $addToSet moment, made single-winner for the fleet plane:
+        # the freeze-marker insert inside BEGIN IMMEDIATE is the race
+        # arbiter (OR IGNORE + rowcount), and the frozen id set commits in
+        # the SAME transaction — a loser observing rowcount 0 is
+        # guaranteed the winner's set is already durable, because the
+        # winner's transaction committed before ours could see its marker
+        with self.db.immediate():
+            cursor = self.db.conn.execute(
+                "INSERT OR IGNORE INTO snapshot_freezes (snapshot) VALUES (?)",
+                (str(snapshot),),
+            )
+            if cursor.rowcount == 0:
+                return False  # a concurrent/earlier freeze already won
             self.db.conn.execute(
                 "INSERT OR IGNORE INTO snapshot_parts (snapshot, participation) "
                 "SELECT ?, id FROM participations WHERE aggregation = ?",
                 (str(snapshot), str(aggregation)),
             )
-            self.db.conn.execute(
-                "INSERT OR IGNORE INTO snapshot_freezes (snapshot) VALUES (?)",
-                (str(snapshot),),
-            )
+        return True
 
     def has_snapshot_freeze(self, aggregation, snapshot):
         row = self._one(
@@ -439,7 +507,7 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
             return
         for _ in jobs:
             chaos.fail("store.enqueue_clerking_job")
-        with self.db.lock, self.db.conn:
+        with self.db.immediate():
             self.db.conn.executemany(
                 "INSERT INTO clerking_jobs (id, clerk, snapshot, done, doc) "
                 "VALUES (?, ?, ?, 0, ?) "
@@ -468,7 +536,9 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
     def lease_clerking_job(self, clerk, lease_seconds, now=None):
         chaos.fail("store.poll_clerking_job")
         now = time.time() if now is None else now
-        with self.db.lock, self.db.conn:
+        # select + stamp in ONE immediate transaction: two processes
+        # polling the same clerk identity cannot both stamp one job
+        with self.db.immediate():
             row = self.db.conn.execute(
                 "SELECT id, doc, leased_until FROM clerking_jobs "
                 "WHERE clerk = ? AND done = 0 AND leased_until <= ? "
@@ -488,6 +558,21 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
             )
             return ClerkingJob.from_obj(json.loads(doc)), expires
 
+    def release_clerking_job_lease(self, clerk, job, expires=None):
+        # graceful drain: hand a still-undone job straight back to the
+        # fleet (leased_until 0 == immediately pollable by any process).
+        # Compare-and-release: with `expires` the UPDATE only matches the
+        # exact lease this caller was granted — a lapsed lease re-granted
+        # to a peer has a new leased_until and stays the peer's
+        sql = ("UPDATE clerking_jobs SET leased_until = 0 "
+               "WHERE clerk = ? AND id = ? AND done = 0 AND leased_until > 0")
+        args = [str(clerk), str(job)]
+        if expires is not None:
+            sql += " AND leased_until = ?"
+            args.append(expires)
+        cursor = self._exec(sql, tuple(args))
+        return cursor.rowcount > 0
+
     def get_clerking_job(self, clerk, job):
         row = self._one(
             "SELECT doc FROM clerking_jobs WHERE clerk = ? AND id = ?",
@@ -499,7 +584,7 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
         chaos.fail("store.create_clerking_result")
         # result write + done-flag flip, atomically (the Mongo store's
         # done-flag queue semantics, clerking_jobs.rs:32-75)
-        with self.db.lock, self.db.conn:
+        with self.db.immediate():
             row = self.db.conn.execute(
                 "SELECT snapshot, done FROM clerking_jobs WHERE clerk = ? AND id = ?",
                 (str(result.clerk), str(result.job)),

@@ -112,7 +112,14 @@ class AggregationsStore(BaseStore):
     def create_participation(self, participation: Participation) -> None: ...
 
     @abc.abstractmethod
-    def create_snapshot(self, snapshot: Snapshot) -> None: ...
+    def create_snapshot(self, snapshot: Snapshot) -> bool:
+        """Conditional insert: record the snapshot iff no record with its
+        id exists yet, and return whether THIS call created it. The
+        record is the snapshot pipeline's commit point, so the insert
+        must be single-winner even across competing server processes
+        (contended-idempotency contract, docs/scaling.md): the loser's
+        pipeline has already upserted the exact same deterministic job
+        set, so losing is convergence, not failure. Never overwrites."""
 
     @abc.abstractmethod
     def list_snapshots(self, aggregation: AggregationId) -> List[SnapshotId]: ...
@@ -128,9 +135,19 @@ class AggregationsStore(BaseStore):
     @abc.abstractmethod
     def snapshot_participations(
         self, aggregation: AggregationId, snapshot: SnapshotId
-    ) -> None:
+    ) -> bool:
         """Freeze the current participation set under the snapshot id — the
-        consistency point that keeps late arrivals out of a running round."""
+        consistency point that keeps late arrivals out of a running round.
+
+        Single-winner across competing server processes: the freeze
+        marker and the frozen id set commit ATOMICALLY, exactly once.
+        Returns True when this call performed the freeze, False when a
+        concurrent (or earlier crashed) attempt already did — in which
+        case the caller must proceed with the WINNER'S frozen set, which
+        is guaranteed readable the moment this returns False. Two
+        processes must never install different frozen sets for one
+        snapshot id: that would mix share generations across clerk
+        columns (docs/scaling.md, contended-idempotency contract)."""
 
     def has_snapshot_freeze(
         self, aggregation: AggregationId, snapshot: SnapshotId
@@ -223,6 +240,25 @@ class ClerkingJobsStore(BaseStore):
             return None
         now = time.time() if now is None else now
         return job, now + lease_seconds
+
+    def release_clerking_job_lease(
+        self, clerk: AgentId, job: ClerkingJobId,
+        expires: Optional[float] = None,
+    ) -> bool:
+        """Drop an active lease early so the NEXT poller (on any worker
+        process) gets the job immediately instead of waiting out the
+        visibility timeout — the graceful-drain path: a terminating
+        worker hands its in-flight clerking work back to the fleet.
+
+        ``expires`` is the expiry instant the caller was granted: when
+        given, ONLY the lease expiring at exactly that instant is
+        released (compare-and-release) — a lease that lapsed and was
+        re-granted to a peer belongs to that peer now and must be left
+        alone, or the drain would expose the peer's in-flight job to a
+        third worker. Returns whether a lease was actually released.
+        No-op (False) on done jobs and on backends without lease
+        support."""
+        return False
 
     @abc.abstractmethod
     def get_clerking_job(

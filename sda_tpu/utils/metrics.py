@@ -196,13 +196,20 @@ def _escape_label(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def prometheus_text() -> str:
+def prometheus_text(labels: Optional[Dict[str, str]] = None) -> str:
     """Render every instrument in the Prometheus text exposition format.
 
     Internal dotted names ride a ``name`` label (three fixed metric
     families) instead of being mangled into the metric-name charset, so
     route templates like ``GET:/v1/agents/{id}`` survive verbatim.
+    ``labels`` adds constant labels to every sample — the fleet plane
+    stamps ``node_id`` here so N workers scraped into one Prometheus
+    keep their series apart (docs/scaling.md).
     """
+    extra = "".join(
+        ',%s="%s"' % (k, _escape_label(str(v)))
+        for k, v in sorted((labels or {}).items())
+    )
     with _lock:
         counts = sorted(_counts.items())
         gauges = sorted(_gauges.items())
@@ -217,12 +224,13 @@ def prometheus_text() -> str:
     if counts:
         lines.append("# TYPE sda_events_total counter")
         for name, v in counts:
-            lines.append('sda_events_total{name="%s"} %d'
-                         % (_escape_label(name), v))
+            lines.append('sda_events_total{name="%s"%s} %d'
+                         % (_escape_label(name), extra, v))
     if gauges:
         lines.append("# TYPE sda_gauge gauge")
         for name, v in gauges:
-            lines.append('sda_gauge{name="%s"} %s' % (_escape_label(name), v))
+            lines.append('sda_gauge{name="%s"%s} %s'
+                         % (_escape_label(name), extra, v))
     if hists:
         lines.append("# TYPE sda_histogram histogram")
         for name, buckets, count_, total in hists:
@@ -231,12 +239,12 @@ def prometheus_text() -> str:
             for idx in sorted(buckets):
                 cumulative += buckets[idx]
                 bound = HIST_MIN * HIST_BASE ** idx
-                lines.append('sda_histogram_bucket{name="%s",le="%.6g"} %d'
-                             % (label, bound, cumulative))
-            lines.append('sda_histogram_bucket{name="%s",le="+Inf"} %d'
-                         % (label, count_))
-            lines.append('sda_histogram_sum{name="%s"} %.9g'
-                         % (label, total))
-            lines.append('sda_histogram_count{name="%s"} %d'
-                         % (label, count_))
+                lines.append('sda_histogram_bucket{name="%s"%s,le="%.6g"} %d'
+                             % (label, extra, bound, cumulative))
+            lines.append('sda_histogram_bucket{name="%s"%s,le="+Inf"} %d'
+                         % (label, extra, count_))
+            lines.append('sda_histogram_sum{name="%s"%s} %.9g'
+                         % (label, extra, total))
+            lines.append('sda_histogram_count{name="%s"%s} %d'
+                         % (label, extra, count_))
     return "\n".join(lines) + "\n"

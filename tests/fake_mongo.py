@@ -73,8 +73,9 @@ def _apply_update(doc: Dict[str, Any], update: Dict[str, Any]) -> None:
 
 
 class _UpdateResult:
-    def __init__(self, matched_count: int):
+    def __init__(self, matched_count: int, upserted_id: Any = None):
         self.matched_count = matched_count
+        self.upserted_id = upserted_id
 
 
 class _Cursor:
@@ -128,6 +129,7 @@ class FakeCollection:
                 _apply_update(doc, {k: v for k, v in update.items()
                                     if k != "$setOnInsert"})
                 self._docs[doc["_id"]] = copy.deepcopy(doc)
+                return _UpdateResult(matched_count=0, upserted_id=doc["_id"])
             return _UpdateResult(matched_count=0)
 
     def find_one(self, query: Dict[str, Any], sort=None) -> Optional[Dict[str, Any]]:

@@ -172,7 +172,14 @@ def test_committed_trajectory_with_synthetic_2x_slowdown_fails(tmp_path):
     if len(committed) < 3:
         pytest.skip("repo has no committed bench trajectory")
     records = regress.load_records(committed)
-    newest = next(e for e in reversed(records) if e["record"] is not None)
+    real = [e for e in records if e["record"] is not None]
+    # synthesize the slowdown from the newest record that HAS comparable
+    # history — tagged records (codec, fleet_nodes) open fresh lineages
+    # with nothing to gate against, by design
+    newest = next(
+        e for e in reversed(real)
+        if sum(regress._comparable(e["record"], other["record"])
+               for other in real if other is not e) >= 2)
     slow = {"n": 99, "cmd": "synthetic", "rc": 0, "tail": "",
             "parsed": dict(newest["record"])}
     slow["parsed"]["value"] = newest["record"]["value"] / 2
