@@ -23,9 +23,53 @@ assert report["client_failures"] == 0, report
 assert report["errors_5xx"] == 0, report["status_counts"]
 assert report["latency_ms"], "empty per-route histogram report"
 assert report["phases_ms"], "empty phase histogram report"
+# round lifecycle: a healthy load run must never degrade or fail a round
+assert report["rounds_degraded"] == 0, report
+assert report["rounds_failed"] == 0, report
 print(f"loadgen smoke OK: {report['load_requests']} load-phase requests, "
       f"{report['sustained_rps']} rps sustained")
 PY
+
+echo "== dead-clerk drill (fixed seed: 1 permanently dead clerk; Shamir degrades bit-exact, additive fails closed)"
+DEAD_SHAMIR=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --chaos --dead-clerks 1 \
+  --chaos-seed 20260803 --chaos-rate 0.05)
+DEAD_ADDITIVE=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --chaos --dead-clerks 1 \
+  --chaos-sharing additive --chaos-seed 20260803 --chaos-rate 0.05)
+ROUND_RECORD=$(mktemp /tmp/sda-round-XXXX.json)
+DEAD_SHAMIR="$DEAD_SHAMIR" DEAD_ADDITIVE="$DEAD_ADDITIVE" ROUND_RECORD="$ROUND_RECORD" python - <<'PY'
+import json, os
+shamir = json.loads(os.environ["DEAD_SHAMIR"].strip().splitlines()[-1])
+additive = json.loads(os.environ["DEAD_ADDITIVE"].strip().splitlines()[-1])
+# packed Shamir: clerking -> degraded -> revealed, bit-exact vs the
+# healthy reference (the surviving 7-of-8 quorum reconstructs exactly)
+states = [s for s, _ in shamir["round_history"]]
+assert shamir["exact"] is True, shamir
+assert "degraded" in states and states[-1] == "revealed", states
+assert shamir["round_dead_clerks"], shamir
+assert shamir["time_to_degraded_s"] and shamir["time_to_degraded_s"] > 0, shamir
+# additive: unrecoverable -> terminal 'failed' with a machine-readable
+# reason BEFORE the drill deadline (no hang), surfaced as a typed error
+assert additive["round_state"] == "failed", additive
+assert additive["round_reason"], additive
+assert additive["failure"] and additive["failure"]["type"] == "RoundFailed", additive
+assert additive["time_to_failed_s"] and additive["time_to_failed_s"] > 0, additive
+record = {
+    "metric": "time to degraded (dead-clerk drill, 8-clerk packed Shamir over HTTP)",
+    "value": shamir["time_to_degraded_s"], "unit": "seconds",
+    "platform": "cpu", "seed": shamir["seed"],
+    "clerking_deadline_s": 1.5,
+}
+with open(os.environ["ROUND_RECORD"], "w") as f:
+    json.dump(record, f)
+print(f"dead-clerk drill OK: shamir {'->'.join(states)} exact={shamir['exact']} "
+      f"time_to_degraded={shamir['time_to_degraded_s']}s; "
+      f"additive failed in {additive['time_to_failed_s']}s "
+      f"({additive['round_reason'][:60]}...)")
+PY
+# the detection-latency record must parse as a bench record and gate
+# (advisory: first record of its metric — it seeds the trailing window)
+python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$ROUND_RECORD"
+rm -f "$ROUND_RECORD"
 
 echo "== wire codec A/B (fixed seed: same round JSON vs binary, bit-exact both ways)"
 CODEC_JSON=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 16 --dim 64 \

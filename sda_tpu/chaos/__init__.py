@@ -50,9 +50,15 @@ class Action:
     """What a triggered failpoint asks the call site to do.
 
     ``kind`` is one of ``"error"`` (raise ``exc``), ``"delay"`` (sleep
-    ``delay_s`` then proceed), or ``"drop"`` (transport-level: abort the
+    ``delay_s`` then proceed), ``"drop"`` (transport-level: abort the
     connection / abandon the unit of work — only meaningful at call sites
-    that know how, e.g. the HTTP handler or the clerk loop).
+    that know how, e.g. the HTTP handler or the clerk loop), or
+    ``"kill"`` (permanent death: the agent whose loop hit the failpoint
+    latches dead for the rest of the drill — unlike every other kind,
+    which is transient, the call site never retries or recovers; see
+    ``SdaClient.clerk_once`` / ``participate``). ``times=K`` kills the
+    first K distinct agents to hit the point, since a latched-dead agent
+    stops consuming hits.
     """
 
     __slots__ = ("kind", "exc", "delay_s")
@@ -69,15 +75,18 @@ class Action:
 
 class _Failpoint:
     def __init__(self, name: str, *, error=None, delay=None, drop=False,
-                 rate: float = 1.0, times: Optional[int] = None,
+                 kill=False, rate: float = 1.0, times: Optional[int] = None,
                  every: Optional[int] = None, after: int = 0, seed: int = 0):
-        if sum(x is not None and x is not False for x in (error, delay)) + bool(drop) != 1:
+        if sum(x is not None and x is not False for x in (error, delay)) \
+                + bool(drop) + bool(kill) != 1:
             raise ValueError(f"failpoint {name!r}: exactly one of "
-                             "error/delay/drop must be set")
+                             "error/delay/drop/kill must be set")
         if every is not None and every < 1:
             raise ValueError(f"failpoint {name!r}: every must be >= 1")
         self.name = name
-        if drop:
+        if kill:
+            self.kind = "kill"
+        elif drop:
             self.kind = "drop"
         elif delay is not None:
             self.kind = "delay"
@@ -120,7 +129,7 @@ class _Failpoint:
             return Action("error", exc=self.exc_factory())
         if self.kind == "delay":
             return Action("delay", delay_s=self.delay_s)
-        return Action("drop")
+        return Action(self.kind)  # "drop" or "kill": no payload
 
 
 class FailpointRegistry:
@@ -216,10 +225,10 @@ def reset() -> None:
 def configure_from_spec(spec: str, seed: int = 0) -> None:
     """Arm failpoints from a compact string (CLI / env friendly):
 
-        "http.server.request=error,rate=0.15;clerk.abandon_job=drop,times=1"
+        "http.server.request=error,rate=0.15;clerk.dies=kill,times=1"
 
     Each ``;``-separated entry is ``name=kind[,key=value...]`` with kind in
-    error|delay:SECONDS|drop and keys rate/times/every/after.
+    error|delay:SECONDS|drop|kill and keys rate/times/every/after.
     """
     for entry in spec.split(";"):
         entry = entry.strip()
@@ -235,6 +244,8 @@ def configure_from_spec(spec: str, seed: int = 0) -> None:
             kwargs["error"] = True
         elif kind == "drop":
             kwargs["drop"] = True
+        elif kind == "kill":
+            kwargs["kill"] = True
         elif kind.startswith("delay:"):
             kwargs["delay"] = float(kind.split(":", 1)[1])
         else:

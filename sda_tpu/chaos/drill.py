@@ -43,11 +43,29 @@ def run_chaos_drill(
     store: str = "memory",
     store_path=None,
     extra_spec: str = None,
+    dead_clerks: int = 0,
+    dead_participants: int = 0,
+    sharing: str = "packed",
+    clerking_deadline_s: float = 1.5,
+    sweep_interval_s: float = 0.2,
 ) -> dict:
     """Run one full aggregation round over HTTP under injected faults.
 
-    Returns the report dict (``exact``, ``injected_ratio``, counters...).
-    Requires libsodium (real sealed-box crypto, as in production rounds).
+    ``dead_clerks`` / ``dead_participants`` arm the PERMANENT-death
+    failpoints (``clerk.dies`` / ``participant.dies``, kind ``kill``):
+    unlike every transient failpoint above, the first K agents to hit the
+    point latch dead for the rest of the drill. With dead clerks the
+    round lifecycle supervisor (``server/lifecycle.py``) is armed — a
+    clerking deadline plus an in-process sweeper — and the drill asserts
+    the protocol's terminal verdict instead of hanging: packed Shamir
+    degrades to the surviving quorum and still reveals bit-exactly;
+    additive sharing (``sharing="additive"``) reaches ``failed`` with a
+    machine-readable reason, surfaced through the typed
+    ``RoundFailed`` raised by ``SdaClient.await_result``.
+
+    Returns the report dict (``exact``, ``injected_ratio``, the round's
+    lifecycle history, counters...). Requires libsodium (real sealed-box
+    crypto, as in production rounds).
     """
     import numpy as np
 
@@ -55,24 +73,38 @@ def run_chaos_drill(
     from ..crypto import MemoryKeystore, sodium
     from ..http import SdaHttpClient, SdaHttpServer
     from ..protocol import (
+        AdditiveSharing,
         Aggregation,
         AggregationId,
         FullMasking,
         PackedShamirSharing,
+        RoundFailed,
         SodiumEncryption,
     )
     from ..server import new_jsonfs_server, new_memory_server, new_sqlite_server
+    from ..server import lifecycle
 
     if not sodium.available():
         raise RuntimeError("the chaos drill needs libsodium (real crypto round)")
 
-    # the golden 8-clerk packed-Shamir committee (tests/test_fault_tolerance):
-    # threshold 7 of 8, so the abandoned job is LIVENESS-critical only via
-    # reissue when every other result is present
-    scheme = PackedShamirSharing(
-        secret_count=3, share_count=8, privacy_threshold=4,
-        prime_modulus=433, omega_secrets=354, omega_shares=150,
-    )
+    if sharing == "additive":
+        # n-of-n additive sharing: computationally cheap, zero tolerance
+        # for clerk loss — the scheme the failed-round path exists for
+        scheme = AdditiveSharing(share_count=8, modulus=433)
+        modulus = scheme.modulus
+    elif sharing == "packed":
+        # the golden 8-clerk packed-Shamir committee
+        # (tests/test_fault_tolerance): threshold 7 of 8, so the abandoned
+        # job is LIVENESS-critical only via reissue when every other
+        # result is present — and exactly one PERMANENTLY dead clerk still
+        # leaves a reconstructing quorum
+        scheme = PackedShamirSharing(
+            secret_count=3, share_count=8, privacy_threshold=4,
+            prime_modulus=433, omega_secrets=354, omega_shares=150,
+        )
+        modulus = scheme.prime_modulus
+    else:
+        raise ValueError(f"unknown sharing {sharing!r}")
 
     obs.reset_all()
     chaos.reset()
@@ -88,6 +120,15 @@ def run_chaos_drill(
     else:
         raise ValueError(f"unknown store {store!r}")
     service_impl.server.clerking_lease_seconds = lease_seconds
+
+    sweeper = None
+    if dead_clerks:
+        # the supervisor plane: a clerking deadline so dead-clerk
+        # detection has a clock, and a sweeper to run the diagnosis
+        service_impl.server.round_deadlines = lifecycle.RoundDeadlines(
+            clerking_s=clerking_deadline_s)
+        sweeper = lifecycle.RoundSweeper(
+            service_impl.server, interval_s=sweep_interval_s).start()
 
     http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
     http_server.start_background()
@@ -130,10 +171,10 @@ def run_chaos_drill(
                 id=AggregationId.random(),
                 title="chaos-drill",
                 vector_dimension=dim,
-                modulus=scheme.prime_modulus,
+                modulus=modulus,
                 recipient=recipient.agent.id,
                 recipient_key=recipient_key,
-                masking_scheme=FullMasking(scheme.prime_modulus),
+                masking_scheme=FullMasking(modulus),
                 committee_sharing_scheme=scheme,
                 recipient_encryption_scheme=SodiumEncryption(),
                 committee_encryption_scheme=SodiumEncryption(),
@@ -153,49 +194,100 @@ def run_chaos_drill(
             chaos.configure("store.create_participation", error=True, times=1,
                             seed=seed)
             chaos.configure("clerk.abandon_job", drop=True, times=1, seed=seed)
+            if dead_clerks:
+                # permanent death: the first K clerks to poll latch dead —
+                # their jobs are never worked, only diagnosed (lifecycle)
+                chaos.configure("clerk.dies", kill=True, times=dead_clerks,
+                                seed=seed)
+            if dead_participants:
+                chaos.configure("participant.dies", kill=True,
+                                times=dead_participants, seed=seed)
             if extra_spec:
                 chaos.configure_from_spec(extra_spec, seed=seed)
 
             rng = np.random.default_rng(seed)
-            inputs = rng.integers(0, scheme.prime_modulus,
+            inputs = rng.integers(0, modulus,
                                   size=(participants, dim), dtype=np.int64)
+            # a dead participant never contributes: the healthy-reference
+            # sum covers exactly the rows that actually reached the round
+            alive_rows = []
             for row in inputs:
                 participant = new_client()
                 participant.upload_agent()
                 participant.participate([int(x) for x in row], agg.id)
+                if not participant._dead:
+                    alive_rows.append(row)
             recipient.end_aggregation(agg.id)  # snapshot + job fan-out
 
-            # clerks keep polling until EVERY job has a result — waiting for
-            # the full committee (not just reconstruction_threshold) is what
-            # forces the abandoned job through the lease-expiry reissue path
+            def round_state():
+                try:
+                    return recipient.service.get_round_status(
+                        recipient.agent, agg.id)
+                except Exception:  # chaos'd poll: state is best-effort
+                    return None
+
+            # clerks keep polling until the round's completion condition:
+            # with NO dead clerks, EVERY job has a result — waiting for
+            # the full committee (not just reconstruction_threshold) is
+            # what forces the abandoned job through the lease-expiry
+            # reissue path. With dead clerks, the supervisor's verdict is
+            # the exit: degraded + a reconstructing quorum, or terminal
+            # failed (additive) — deterministically, instead of hanging.
+            threshold = scheme.reconstruction_threshold
             deadline = time.monotonic() + timeout_s
             ready = False
+            final_round = None
             while time.monotonic() < deadline:
                 for clerk in clerks:
                     clerk.run_chores(-1)
                 status = recipient.service.get_aggregation_status(
                     recipient.agent, agg.id
                 )
-                if (
-                    status is not None
-                    and status.snapshots
-                    and status.snapshots[0].number_of_clerking_results
-                    >= scheme.share_count
-                ):
+                results = (status.snapshots[0].number_of_clerking_results
+                           if status is not None and status.snapshots else 0)
+                if not dead_clerks and results >= scheme.share_count:
                     ready = True
                     break
+                if dead_clerks:
+                    final_round = round_state()
+                    if final_round is not None:
+                        if final_round.state == "failed":
+                            break
+                        if (final_round.state == "degraded"
+                                and results >= threshold):
+                            ready = True
+                            break
                 time.sleep(min(0.1, lease_seconds / 4))
 
             exact = False
+            failure = None
             if ready:
-                output = recipient.reveal_aggregation(agg.id)
-                expected = inputs.sum(axis=0) % scheme.prime_modulus
+                # the lifecycle-aware blocking reveal: returns the output,
+                # or raises the typed verdict with the server's diagnosis
+                output = recipient.await_result(
+                    agg.id, deadline=max(1.0, deadline - time.monotonic()))
+                expected = (np.stack(alive_rows).sum(axis=0) % modulus
+                            if alive_rows else np.zeros(dim, dtype=np.int64))
                 exact = bool((output.positive().values == expected).all())
+            elif dead_clerks:
+                try:
+                    recipient.await_result(agg.id, deadline=1.0,
+                                           poll_interval=0.05)
+                except RoundFailed as e:  # RoundExpired is a subclass
+                    failure = {
+                        "type": type(e).__name__,
+                        "state": e.state,
+                        "reason": e.reason,
+                        "dead_clerks": [str(c) for c in e.dead_clerks],
+                    }
+            final_round = round_state() or final_round
     finally:
         # snapshot the schedule, then disarm BEFORE shutdown so teardown
         # requests aren't chaos'd
         failpoint_report = chaos.report()
         chaos.reset()
+        if sweeper is not None:
+            sweeper.stop()
         http_server.shutdown()
 
     from ..loadgen import latency_report_ms as _latency_report_ms
@@ -215,16 +307,44 @@ def run_chaos_drill(
     # (every span shares its trace id); chaos_events names each injection
     # and the span it hit, critical_path the chain that set round duration
     timelines = obs.round_timelines()
+
+    def _phase_gap(history, start_state, end_state):
+        """Server-stamped seconds between two lifecycle transitions."""
+        stamps = {state: ts for state, ts in (history or [])}
+        if start_state in stamps and end_state in stamps:
+            return round(stamps[end_state] - stamps[start_state], 4)
+        return None
+
+    round_history = (final_round.history
+                     if dead_clerks and final_round is not None else None)
     report = {
         "mode": f"chaos drill over HTTP ({store} store)",
         "participants": participants,
         "dim": dim,
         "clerks": scheme.share_count,
+        "sharing": sharing,
+        "dead_clerks": dead_clerks,
+        "dead_participants": dead_participants,
         "rate": rate,
         "seed": seed,
         "lease_seconds": lease_seconds,
         "ready": ready,
         "exact": exact,
+        # round lifecycle verdict (server/lifecycle.py): terminal state,
+        # transition history with server-side stamps, and the diagnosis —
+        # plus the BENCH-style detection latencies the regress gate
+        # tracks advisory (ci.sh dead-clerk drill)
+        "round_state": (final_round.state
+                        if final_round is not None else None),
+        "round_reason": (final_round.reason
+                         if final_round is not None else None),
+        "round_dead_clerks": ([str(c) for c in final_round.dead_clerks]
+                              if final_round is not None else None),
+        "round_history": round_history,
+        "time_to_degraded_s": _phase_gap(round_history, "clerking",
+                                         "degraded"),
+        "time_to_failed_s": _phase_gap(round_history, "clerking", "failed"),
+        "failure": failure,
         "injected_faults": injected,
         "failed_requests": failed_requests,
         "injected_ratio": round(failed_requests / max(1, requests_total), 4),

@@ -69,6 +69,27 @@ def build_parser() -> argparse.ArgumentParser:
                              "accepting, wait up to SECONDS for in-flight "
                              "requests, release held clerking-job leases "
                              "back to the shared store, then exit")
+    parser.add_argument("--round-sweep", type=float, metavar="SECONDS",
+                        default=None,
+                        help="run the round lifecycle sweeper every "
+                             "SECONDS in this worker: expires rounds past "
+                             "their phase deadlines and diagnoses dead "
+                             "clerks (degraded/failed). Store-arbitrated: "
+                             "in a fleet every worker may sweep, exactly "
+                             "one wins each transition (docs/robustness.md)")
+    parser.add_argument("--round-collect-deadline", type=float,
+                        metavar="SECONDS", default=None,
+                        help="round lifecycle: an aggregation with no "
+                             "snapshot after SECONDS expires (terminal "
+                             "'expired' state; needs --round-sweep)")
+    parser.add_argument("--round-clerk-deadline", type=float,
+                        metavar="SECONDS", default=None,
+                        help="round lifecycle: past SECONDS after job "
+                             "fan-out, undone jobs with no active lease "
+                             "mark their clerks dead — Shamir rounds "
+                             "degrade to the surviving quorum, additive "
+                             "rounds fail with a diagnosis (needs "
+                             "--round-sweep)")
     parser.add_argument("--chaos-spec", type=str, default=None,
                         help="arm failpoints in THIS worker process, e.g. "
                              "'http.server.request=error,rate=0.05' (the "
@@ -109,6 +130,20 @@ def main(argv=None) -> int:
         service.server.premix_paillier = True
     if args.job_lease is not None:
         service.server.clerking_lease_seconds = args.job_lease
+    sweeper = None
+    if args.round_collect_deadline is not None \
+            or args.round_clerk_deadline is not None:
+        from ..server import lifecycle
+
+        service.server.round_deadlines = lifecycle.RoundDeadlines(
+            collecting_s=args.round_collect_deadline,
+            clerking_s=args.round_clerk_deadline,
+        )
+    if args.round_sweep is not None:
+        from ..server import lifecycle
+
+        sweeper = lifecycle.RoundSweeper(
+            service.server, interval_s=args.round_sweep).start()
     if args.chaos_spec:
         from .. import chaos
 
@@ -156,6 +191,10 @@ def main(argv=None) -> int:
         stop.wait()
     except KeyboardInterrupt:  # SIGINT delivered before the handler landed
         pass
+    if sweeper is not None:
+        # stop sweeping BEFORE the drain releases leases: a sweep racing
+        # the lease handback could read a transiently unleased job as dead
+        sweeper.stop()
     summary = server.drain(grace_s=args.drain_grace)
     print(f"sdad drained {json.dumps(summary)}", flush=True)
     return 0 if summary["leaked"] == 0 else 1

@@ -124,6 +124,20 @@ def build_parser() -> argparse.ArgumentParser:
                         help="extra failpoints, e.g. "
                              "'store.poll_clerking_job=error,times=2' "
                              "(see sda_tpu.chaos.configure_from_spec)")
+    parser.add_argument("--dead-clerks", type=int, metavar="K", default=0,
+                        help="permanently kill K clerks (clerk.dies kill "
+                             "failpoint) and arm the round lifecycle "
+                             "supervisor: packed Shamir must complete "
+                             "degraded + bit-exact from the surviving "
+                             "quorum, additive must reach terminal "
+                             "'failed' before the deadline (--chaos; "
+                             "docs/robustness.md)")
+    parser.add_argument("--chaos-sharing", choices=["packed", "additive"],
+                        default="packed",
+                        help="committee sharing scheme for the chaos "
+                             "drill: packed Shamir tolerates dead clerks "
+                             "down to its reconstruction threshold, "
+                             "additive tolerates none (--chaos)")
     parser.add_argument("--drop-clerks", type=str, metavar="I,J,...",
                         default=None,
                         help="simulate losing these clerk indices: the "
@@ -348,10 +362,25 @@ def _run_chaos(args) -> int:
             store=args.chaos_store,
             store_path=None if args.chaos_store == "memory" else f"{tmp}/store",
             extra_spec=args.chaos_spec,
+            dead_clerks=args.dead_clerks,
+            sharing=args.chaos_sharing,
         )
     _export_trace(args, report)
     print(json.dumps(report))
-    return 0 if report["exact"] else 1
+    if args.dead_clerks and args.chaos_sharing == "additive":
+        # additive cannot survive a dead clerk: success is a DETERMINISTIC
+        # terminal 'failed' with a machine-readable reason (no hang)
+        ok = (report.get("round_state") == "failed"
+              and bool(report.get("round_reason")))
+    elif args.dead_clerks:
+        # packed Shamir: success is degraded-then-revealed, bit-exact
+        # from the surviving quorum
+        states = [s for s, _ in (report.get("round_history") or [])]
+        ok = (bool(report["exact"]) and "degraded" in states
+              and report.get("round_state") in ("degraded", "revealed"))
+    else:
+        ok = bool(report["exact"])
+    return 0 if ok else 1
 
 
 def main(argv=None) -> int:

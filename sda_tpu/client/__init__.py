@@ -9,6 +9,7 @@ Participating/Clerking/Receiving/Maintenance traits).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -34,10 +35,14 @@ from ..protocol import (
     Participation,
     ParticipationId,
     Profile,
+    RoundExpired,
+    RoundFailed,
     SdaService,
     Snapshot,
     SnapshotId,
 )
+
+log = logging.getLogger(__name__)
 
 
 #: Largest modulus whose residues are exactly representable in int64 —
@@ -123,6 +128,10 @@ class SdaClient:
         # SDA_CLIENT_CACHE=0 disables caching entirely.
         self._doc_cache: dict = {}
         self._doc_cache_lock = threading.Lock()
+        # permanent-death latch for the chaos drills: once the
+        # clerk.dies / participant.dies failpoint kills this agent, its
+        # loop stays dead for the rest of the drill (chaos/drill.py)
+        self._dead = False
 
     # -- immutable-document cache --------------------------------------
     @staticmethod
@@ -230,6 +239,16 @@ class SdaClient:
 
     def participate(self, input: Sequence[int], aggregation: AggregationId) -> None:
         """new_participation + upload in one go (participate.rs:31-35)."""
+        # permanent-death failpoint (chaos drills): a participant that
+        # dies never contributes — the round's expected sum must exclude
+        # it (PAPER.md's sporadic phones, made injectable)
+        from .. import chaos
+
+        if self._dead or chaos.evaluate(
+                "participant.dies", kinds=("kill",)) is not None:
+            self._dead = True
+            metrics.count("participant.died")
+            return
         with obs.span("participant.participate",
                       attributes={"aggregation": str(aggregation)}):
             self.upload_participation(self.new_participation(input, aggregation))
@@ -341,6 +360,18 @@ class SdaClient:
     def clerk_once(self) -> bool:
         """Poll-process-upload one job; False when the queue is dry
         (clerk.rs:25-37)."""
+        # permanent-death failpoint: unlike clerk.abandon_job (transient —
+        # the job was pulled, the lease reissues it), a dead clerk never
+        # polls again, so its jobs are only ever finished by a sibling
+        # worker of the same identity — or diagnosed dead by the round
+        # sweeper (server/lifecycle.py). Checked BEFORE the poll so a
+        # dying clerk cannot take a lease to its grave.
+        from .. import chaos
+
+        if self._dead or chaos.evaluate(
+                "clerk.dies", kinds=("kill",)) is not None:
+            self._dead = True
+            return False
         job = self.service.get_clerking_job(self.agent, self.agent.id)
         if job is None:
             return False
@@ -358,8 +389,6 @@ class SdaClient:
             # failpoint: the clerk dies AFTER pulling work — the job is
             # pulled (and, with leasing, invisible to its siblings) but no
             # result ever lands; lease expiry is what brings it back
-            from .. import chaos
-
             if chaos.evaluate("clerk.abandon_job", kinds=("drop",)) is not None:
                 job_span.set_attribute("abandoned", True)
                 return False
@@ -582,6 +611,74 @@ class SdaClient:
             self.service.create_snapshot(self.agent, snapshot)
         return snapshot.id
 
+    def await_result(
+        self,
+        aggregation_id: AggregationId,
+        *,
+        deadline: Optional[float] = None,
+        poll_interval: float = 0.1,
+        snapshot_id: Optional[SnapshotId] = None,
+    ) -> RecipientOutput:
+        """Block until the round completes, then reveal and return the
+        output — the lifecycle-aware replacement for hand-rolled
+        ``result_ready`` polling.
+
+        Polls the server's round state (``GET /v1/aggregations/{id}/round``,
+        ``server/lifecycle.py``) alongside the snapshot status. A round
+        the supervisor declared terminally ``failed`` raises
+        :class:`~sda_tpu.protocol.RoundFailed` and ``expired`` raises
+        :class:`~sda_tpu.protocol.RoundExpired`, each carrying the
+        server's machine-readable diagnosis (``reason``, ``dead_clerks``,
+        ``state``) — a dead clerk under additive sharing fails fast here
+        instead of hanging forever. Against a pre-supervisor server (no
+        round route) this degrades to plain result-ready polling.
+
+        ``deadline`` bounds the wait in seconds client-side (``None`` =
+        wait for a server verdict indefinitely); exceeding it raises
+        ``RoundExpired`` too, tagged as the client's deadline.
+        """
+        give_up = (None if deadline is None
+                   else time.monotonic() + float(deadline))
+        round_status = None
+        with obs.span("recipient.await_result",
+                      attributes={"aggregation": str(aggregation_id)}):
+            while True:
+                round_status = self.service.get_round_status(
+                    self.agent, aggregation_id)
+                if round_status is not None and round_status.state in (
+                        "failed", "expired"):
+                    exc = (RoundExpired if round_status.state == "expired"
+                           else RoundFailed)
+                    raise exc(
+                        f"round {aggregation_id} is {round_status.state}: "
+                        f"{round_status.reason or 'no reason recorded'}",
+                        state=round_status.state,
+                        reason=round_status.reason,
+                        dead_clerks=round_status.dead_clerks,
+                    )
+                status = self.service.get_aggregation_status(
+                    self.agent, aggregation_id)
+                if status is not None:
+                    if snapshot_id is not None:
+                        snap = next((s for s in status.snapshots
+                                     if s.id == snapshot_id), None)
+                    else:
+                        snap = next((s for s in status.snapshots
+                                     if s.result_ready), None)
+                    if snap is not None and snap.result_ready:
+                        return self.reveal_aggregation(aggregation_id, snap.id)
+                if give_up is not None and time.monotonic() >= give_up:
+                    raise RoundExpired(
+                        f"await_result deadline exceeded client-side for "
+                        f"{aggregation_id}" + (
+                            f" (server round state: {round_status.state})"
+                            if round_status is not None else ""),
+                        state=(round_status.state
+                               if round_status is not None else None),
+                        reason="client await_result deadline exceeded",
+                    )
+                time.sleep(poll_interval)
+
     def reveal_aggregation(
         self, aggregation_id: AggregationId, snapshot_id: Optional[SnapshotId] = None
     ) -> RecipientOutput:
@@ -638,11 +735,26 @@ class SdaClient:
             def decrypt_result(clerking_result):
                 ix = clerk_positions.get(clerking_result.clerk)
                 if ix is None:
-                    raise NotFound(f"missing clerk {clerking_result.clerk}")
+                    # an unknown-clerk result (stale data, a buggy or
+                    # hostile peer) must not abort the whole reveal from
+                    # inside the crypto pool: skip it with a counted
+                    # warning and reconstruct from the remaining quorum —
+                    # the reconstructor below still enforces the
+                    # reconstruction threshold on what survives
+                    log.warning(
+                        "reveal %s: skipping result from unknown clerk %s "
+                        "(not in the committee)",
+                        aggregation_id, clerking_result.clerk,
+                    )
+                    metrics.count("recipient.result.unknown_clerk")
+                    return None
                 return (ix, decryptor.decrypt(clerking_result.encryption))
 
-            indexed_shares = crypto_batch.pmap(
-                decrypt_result, result.clerk_encryptions)
+            indexed_shares = [
+                pair for pair in crypto_batch.pmap(
+                    decrypt_result, result.clerk_encryptions)
+                if pair is not None
+            ]
 
         reconstructor = self.crypto.new_secret_reconstructor(
             aggregation.committee_sharing_scheme, aggregation.vector_dimension

@@ -101,6 +101,17 @@ class AdditiveReconstructor(SecretReconstructor):
         self.scheme = scheme
 
     def reconstruct(self, indexed_shares):
+        # additive sharing is n-of-n: a missing share makes the sum an
+        # unrelated uniform value, so fail closed like the Shamir
+        # reconstructor does below its quorum — silently summing a
+        # partial set would reveal garbage as if it were the aggregate
+        r = self.scheme.reconstruction_threshold
+        if len(indexed_shares) < r:
+            raise ValueError(
+                f"need at least {r} shares to reconstruct, got "
+                f"{len(indexed_shares)} (additive sharing cannot tolerate "
+                f"share loss)"
+            )
         return mod_combine([v for (_, v) in indexed_shares], self.scheme.modulus)
 
 

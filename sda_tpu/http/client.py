@@ -38,6 +38,7 @@ from ..protocol import (
     Participation,
     PermissionDenied,
     Pong,
+    RoundStatus,
     SdaService,
     ServerError,
     SnapshotResult,
@@ -486,6 +487,18 @@ class SdaHttpClient(SdaService):
             self._get(caller, f"/v1/aggregations/{aggregation}/status"),
             AggregationStatus.from_obj,
         )
+
+    def get_round_status(self, caller, aggregation):
+        try:
+            response = self._get(
+                caller, f"/v1/aggregations/{aggregation}/round")
+        except NotFound:
+            # bare 404 (no X-Resource-Not-Found): an old server without
+            # the round-lifecycle route — report "not tracked", exactly
+            # like the in-process default, so await_result degrades to
+            # plain result_ready polling against pre-supervisor peers
+            return None
+        return self._option(response, RoundStatus.from_obj)
 
     def create_snapshot(self, caller, snapshot):
         self._post(caller, "/v1/aggregations/implied/snapshot", snapshot.to_obj())

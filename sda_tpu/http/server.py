@@ -66,6 +66,7 @@ from ..protocol import (
 )
 from ..protocol import bincodec
 from ..server import SdaServerService, auth_token
+from ..server import lifecycle as _lifecycle
 from ..server.routing import NODE_HEADER
 from ..utils import metrics
 from .. import chaos, obs
@@ -97,6 +98,7 @@ _ROUTE_TEMPLATES = frozenset({
     "/v1/aggregations/{id}/committee",
     "/v1/aggregations/participations",
     "/v1/aggregations/{id}/status",
+    "/v1/aggregations/{id}/round",
     "/v1/aggregations/implied/snapshot",
     "/v1/aggregations/any/jobs",
     "/v1/aggregations/implied/jobs/{id}/result",
@@ -521,6 +523,16 @@ class _Handler(BaseHTTPRequestHandler):
                             caller, AggregationId(r.group(1))
                         )
                     )
+            if r := m(rf"/v1/aggregations/({_ID})/round"):
+                if method == "GET":
+                    # round lifecycle state (server/lifecycle.py): what a
+                    # blocking client polls instead of result_ready alone —
+                    # terminal failed/expired states carry the diagnosis
+                    return self._reply_option(
+                        self.service.get_round_status(
+                            caller, AggregationId(r.group(1))
+                        )
+                    )
             if path == "/v1/aggregations/implied/snapshot" and method == "POST":
                 snap = Snapshot.from_obj(self._json_body())
                 self.service.create_snapshot(caller, snap)
@@ -718,6 +730,11 @@ class SdaHttpServer:
             # contended-idempotency visibility: how often this worker's
             # snapshot pipeline won, lost, or converged on a peer's freeze
             "snapshot": metrics.counter_report("server.snapshot.") or {},
+            # round lifecycle table (server/lifecycle.py): per-state
+            # tallies + the most recently updated rounds with their
+            # terminal diagnoses — the fleet's shared-store view, so any
+            # worker's scrape shows every round
+            "rounds": _lifecycle.rounds_report(service.server),
             # fleet drills arm failpoints per worker (sdad --chaos-spec);
             # the scrape proves the faults actually fired in THIS process
             "failpoints": chaos.report() or {},

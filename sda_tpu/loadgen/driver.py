@@ -482,6 +482,22 @@ def run_load(profile: LoadProfile) -> dict:
     requests_total = sum(status_counts.values())
     shed = sum(v for k, v in status_counts.items() if k == 429)
     errors_5xx = sum(v for k, v in status_counts.items() if k >= 500)
+    # round lifecycle verdicts (server/lifecycle.py): a healthy load run
+    # must never degrade or fail a round — ci.sh asserts both stay 0.
+    # In-process runs read the transition counters; fleet runs read ONE
+    # worker's /statusz rounds table (the store is shared, every worker
+    # sees the same rounds — summing scrapes would double-count)
+    if fleet is not None:
+        _any_scrape = next(iter(final_scrapes.values()), {})
+        _rounds_by_state = (_any_scrape.get("rounds") or {}).get(
+            "by_state") or {}
+        rounds_degraded = _rounds_by_state.get("degraded", 0)
+        rounds_failed = (_rounds_by_state.get("failed", 0)
+                         + _rounds_by_state.get("expired", 0))
+    else:
+        rounds_degraded = counters.get("server.round.state.degraded", 0)
+        rounds_failed = (counters.get("server.round.state.failed", 0)
+                         + counters.get("server.round.state.expired", 0))
     report = {
         "mode": (f"loadgen {profile.arrivals}-loop "
                  f"({profile.store} store"
@@ -525,6 +541,8 @@ def run_load(profile: LoadProfile) -> dict:
         "requests": requests_total,
         "shed_429": shed,
         "errors_5xx": errors_5xx,
+        "rounds_degraded": rounds_degraded,
+        "rounds_failed": rounds_failed,
         "status_counts": {str(k): v for k, v in sorted(status_counts.items())},
         "throttled": metrics.counter_report("http.throttled.") or None,
         "retries": metrics.counter_report("http.retry.") or None,

@@ -43,3 +43,29 @@ class NotFound(SdaError):
 
 class ServerError(SdaError):
     """Internal server failure (HTTP 500)."""
+
+
+class RoundFailed(SdaError):
+    """The round lifecycle supervisor declared the round terminally
+    ``failed`` — e.g. a dead clerk under additive sharing (every share is
+    required) or dead clerks leaving a Shamir committee below its
+    reconstruction threshold. Carries the server's diagnosis so callers
+    can act on it programmatically (``server/lifecycle.py``)."""
+
+    def __init__(self, message: str = "round failed", *, state=None,
+                 reason=None, dead_clerks=None):
+        super().__init__(message)
+        self.state = state
+        self.reason = reason
+        self.dead_clerks = list(dead_clerks or [])
+
+
+class RoundExpired(RoundFailed):
+    """The round ran out of time — a phase deadline lapsed server-side
+    (terminal ``expired`` state) or a client-side ``await_result``
+    deadline was exceeded before the round completed."""
+
+    def __init__(self, message: str = "round expired", *, state=None,
+                 reason=None, dead_clerks=None):
+        super().__init__(message, state=state, reason=reason,
+                         dead_clerks=dead_clerks)

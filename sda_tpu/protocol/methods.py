@@ -149,6 +149,16 @@ class SdaRecipientService(SdaBaseService):
         self, caller: Agent, aggregation: AggregationId, snapshot: SnapshotId
     ) -> Optional[SnapshotResult]: ...
 
+    def get_round_status(
+        self, caller: Agent, aggregation: AggregationId
+    ) -> Optional["RoundStatus"]:
+        """Lifecycle state of the aggregation's current round
+        (``server/lifecycle.py`` state machine), or ``None`` when this
+        service does not track round lifecycle — deliberately concrete
+        (not abstract) so pre-supervisor service implementations keep
+        working unchanged."""
+        return None
+
 
 class SdaService(
     SdaAgentService,

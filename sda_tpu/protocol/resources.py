@@ -494,6 +494,89 @@ class AggregationStatus:
         )
 
 
+class RoundStatus:
+    """Lifecycle state of an aggregation's current round — the explicit
+    state machine the round supervisor persists (``server/lifecycle.py``:
+    ``collecting → frozen → clerking → ready → revealed`` plus terminal
+    ``degraded``/``failed``/``expired``). ``results`` is the LIVE
+    clerking-result count; ``history`` is the bounded list of
+    ``[state, unix_ts]`` transition stamps."""
+
+    __slots__ = ("aggregation", "state", "snapshot", "scheme",
+                 "committee_size", "reconstruction_threshold", "results",
+                 "dead_clerks", "reason", "deadline_at", "updated_at",
+                 "history")
+
+    def __init__(
+        self,
+        aggregation: AggregationId,
+        state: str,
+        snapshot: Optional[SnapshotId] = None,
+        scheme: Optional[str] = None,
+        committee_size: int = 0,
+        reconstruction_threshold: int = 0,
+        results: int = 0,
+        dead_clerks=None,
+        reason: Optional[str] = None,
+        deadline_at: Optional[float] = None,
+        updated_at: Optional[float] = None,
+        history=None,
+    ):
+        self.aggregation = aggregation
+        self.state = str(state)
+        self.snapshot = snapshot
+        self.scheme = scheme
+        self.committee_size = int(committee_size)
+        self.reconstruction_threshold = int(reconstruction_threshold)
+        self.results = int(results)
+        self.dead_clerks = [AgentId(c) for c in (dead_clerks or [])]
+        self.reason = reason
+        self.deadline_at = None if deadline_at is None else float(deadline_at)
+        self.updated_at = None if updated_at is None else float(updated_at)
+        self.history = [[str(s), float(ts)] for (s, ts) in (history or [])]
+
+    def __eq__(self, other):
+        return isinstance(other, RoundStatus) and self.to_obj() == other.to_obj()
+
+    def __repr__(self):
+        return (f"RoundStatus(aggregation={self.aggregation!r}, "
+                f"state={self.state!r}, results={self.results})")
+
+    def to_obj(self):
+        return {
+            "aggregation": self.aggregation.to_obj(),
+            "state": self.state,
+            "snapshot": None if self.snapshot is None else self.snapshot.to_obj(),
+            "scheme": self.scheme,
+            "committee_size": self.committee_size,
+            "reconstruction_threshold": self.reconstruction_threshold,
+            "results": self.results,
+            "dead_clerks": [c.to_obj() for c in self.dead_clerks],
+            "reason": self.reason,
+            "deadline_at": self.deadline_at,
+            "updated_at": self.updated_at,
+            "history": [[s, ts] for (s, ts) in self.history],
+        }
+
+    @classmethod
+    def from_obj(cls, obj):
+        snap = obj.get("snapshot")
+        return cls(
+            aggregation=AggregationId.from_obj(obj["aggregation"]),
+            state=obj["state"],
+            snapshot=None if snap is None else SnapshotId.from_obj(snap),
+            scheme=obj.get("scheme"),
+            committee_size=obj.get("committee_size") or 0,
+            reconstruction_threshold=obj.get("reconstruction_threshold") or 0,
+            results=obj.get("results") or 0,
+            dead_clerks=obj.get("dead_clerks") or [],
+            reason=obj.get("reason"),
+            deadline_at=obj.get("deadline_at"),
+            updated_at=obj.get("updated_at"),
+            history=obj.get("history") or [],
+        )
+
+
 class SnapshotResult:
     """Everything the recipient needs to reconstruct: clerk results + masks."""
 

@@ -43,6 +43,7 @@ from ..protocol import (
 )
 from .. import obs
 from ..utils import metrics
+from . import lifecycle
 from . import snapshot as snapshot_mod
 from .stores import (
     AgentsStore,
@@ -91,6 +92,11 @@ class SdaServer:
         # what graceful drain hands back to the fleet (release_held_leases)
         self._granted_leases: dict = {}
         self._granted_lock = threading.Lock()
+        #: per-phase round deadlines for the lifecycle supervisor
+        #: (lifecycle.py); the default (all None) tracks states but never
+        #: expires anything — arm via sdad --round-collect-deadline /
+        #: --round-clerk-deadline and sweep with --round-sweep
+        self.round_deadlines = lifecycle.RoundDeadlines()
 
     # -- health ------------------------------------------------------------
     def ping(self) -> Pong:
@@ -128,6 +134,10 @@ class SdaServer:
 
     def create_aggregation(self, aggregation: Aggregation) -> None:
         self.aggregation_store.create_aggregation(aggregation)
+        # lifecycle: the aggregation's round starts collecting the moment
+        # the resource exists (the supervisor's state machine is durable
+        # in the same store the aggregation is)
+        lifecycle.note_collecting(self, aggregation)
 
     def delete_aggregation(self, aggregation: AggregationId) -> None:
         self.aggregation_store.delete_aggregation(aggregation)
@@ -226,13 +236,26 @@ class SdaServer:
     ) -> Optional[ClerkingJob]:
         return self.clerking_job_store.get_clerking_job(clerk, job)
 
-    def create_clerking_result(self, result: ClerkingResult) -> None:
+    def create_clerking_result(
+        self, result: ClerkingResult, job: Optional[ClerkingJob] = None
+    ) -> None:
         with obs.span("server.create_result",
                       attributes={"job": str(result.job)}):
             self.clerking_job_store.create_clerking_result(result)
         with self._granted_lock:
             self._granted_leases.pop(result.job, None)
         metrics.count("server.clerking_result.created")
+        # lifecycle: a full committee's worth of results flips the round
+        # to ready (threshold-satisfying partial sets stay clerking —
+        # the sweeper decides whether the stragglers are dead). The
+        # service wrapper already fetched the (payload-heavy) job for its
+        # ACL check and passes it down; only direct core callers pay the
+        # extra read.
+        if job is None:
+            job = self.clerking_job_store.get_clerking_job(
+                result.clerk, result.job)
+        if job is not None:
+            lifecycle.note_result(self, job)
 
     def release_held_leases(self) -> int:
         """Graceful-drain step: hand every clerking-job lease this worker
@@ -275,6 +298,9 @@ class SdaServer:
             if result is None:
                 raise NotFound("inconsistent storage")
             results.append(result)
+        # lifecycle: a reconstruction-grade fetch is the reveal — the
+        # round (ready, or degraded-completing-from-quorum) is done
+        lifecycle.note_revealed(self, aggregation, snapshot, len(results))
         return SnapshotResult(
             snapshot=snapshot,
             number_of_participations=self.aggregation_store.count_participations_snapshot(
@@ -283,6 +309,12 @@ class SdaServer:
             clerk_encryptions=results,
             recipient_encryptions=self.aggregation_store.get_snapshot_mask(snapshot),
         )
+
+    def get_round_status(self, aggregation: AggregationId):
+        """Lifecycle state of the aggregation's current round (the stored
+        state-machine document plus the live result count), or None when
+        nothing is tracked (pre-supervisor data)."""
+        return lifecycle.round_status(self, aggregation)
 
     # -- auth tokens (used by the HTTP layer) ------------------------------
     def upsert_auth_token(self, token: AuthToken) -> None:
@@ -388,6 +420,12 @@ class SdaServerService(SdaService):
         self._recipient_only(caller, aggregation)
         return self.server.get_snapshot_result(aggregation, snapshot)
 
+    def get_round_status(self, caller, aggregation):
+        # recipient-only like status: the round's failure diagnosis names
+        # dead clerks, which is committee topology the public cannot see
+        self._recipient_only(caller, aggregation)
+        return self.server.get_round_status(aggregation)
+
     # -- participation service ---------------------------------------------
     def create_participation(self, caller, participation):
         _acl_agent_is(caller, participation.participant)
@@ -406,4 +444,4 @@ class SdaServerService(SdaService):
         if job is None:
             raise NotFound("job not found")
         _acl_agent_is(caller, job.clerk)
-        self.server.create_clerking_result(result)
+        self.server.create_clerking_result(result, job=job)

@@ -41,6 +41,7 @@ from .. import chaos
 from ..utils import metrics
 from ..protocol import (
     Agent,
+    AgentId,
     Aggregation,
     ClerkCandidate,
     ClerkingJob,
@@ -238,6 +239,7 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
                 shutil.rmtree(self.root / sub / str(aggregation), ignore_errors=True)
             (self.root / "aggregations" / f"{aggregation}.json").unlink(missing_ok=True)
             (self.root / "committees" / f"{aggregation}.json").unlink(missing_ok=True)
+            (self.root / "rounds" / f"{aggregation}.json").unlink(missing_ok=True)
 
     def get_committee(self, aggregation):
         with self._lock:
@@ -338,6 +340,37 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
                     out.append(None if enc is None else Encryption.from_obj(enc))
             return out
 
+    # -- round lifecycle ----------------------------------------------------
+    def put_round_state(self, doc):
+        with self._lock:
+            _write_json(self.root / "rounds" / f"{doc['aggregation']}.json",
+                        doc)
+
+    def get_round_state(self, aggregation):
+        with self._lock:
+            return _read_json(self.root / "rounds" / f"{aggregation}.json")
+
+    def list_round_states(self):
+        with self._lock:
+            out = []
+            for agg_id in _ids_in(self.root / "rounds"):
+                doc = _read_json(self.root / "rounds" / f"{agg_id}.json")
+                if doc is not None:
+                    out.append(doc)
+            return out
+
+    def transition_round_state(self, aggregation, from_states, doc):
+        # single-winner CAS across fleet worker processes: the dir flock
+        # makes the read-check-write atomic (link(2) arbitration only
+        # covers create-if-absent; a transition REPLACES the file)
+        with self._lock, self._dir_lock(self.root / "rounds"):
+            path = self.root / "rounds" / f"{aggregation}.json"
+            current = _read_json(path)
+            if current is None or current.get("state") not in from_states:
+                return False
+            _write_json(path, doc)
+            return True
+
     def create_snapshot_mask(self, snapshot, mask):
         with self._lock:
             _write_json(
@@ -422,6 +455,32 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
                 return False
             lease_path.unlink(missing_ok=True)
             return True
+
+    def list_snapshot_jobs(self, snapshot):
+        # the sweeper's dead-clerk census: walk both queue trees, decode
+        # only the snapshot field to filter — committee-width work, and
+        # sweeps are rare control-plane reads
+        with self._lock:
+            out = []
+            for sub, done in (("queue", False), ("done", True)):
+                base = self.root / sub
+                if not base.is_dir():
+                    continue
+                for clerk_dir in sorted(p for p in base.iterdir()
+                                        if p.is_dir()):
+                    for job_id in _ids_in(clerk_dir):
+                        obj = _read_json(clerk_dir / f"{job_id}.json")
+                        if obj is None or obj.get("snapshot") != str(snapshot):
+                            continue
+                        lease = 0.0
+                        if not done:
+                            lease_doc = _read_json(
+                                clerk_dir / f".lease-{job_id}.json")
+                            if lease_doc is not None:
+                                lease = float(lease_doc.get("expires", 0.0))
+                        out.append((ClerkingJobId(job_id),
+                                    AgentId(clerk_dir.name), done, lease))
+            return out
 
     def get_clerking_job(self, clerk, job):
         with self._lock:

@@ -1,0 +1,453 @@
+"""Round lifecycle supervisor: explicit state machine + deadline sweeper.
+
+SDA's whole premise is weak, sporadic devices, and packed-Shamir sharing
+exists precisely so a round survives missing clerks — but nothing ever
+*decided* a clerk was gone: a permanently dead clerk's job lease-reissued
+forever and an additive round hung silently with no terminal state. This
+module closes that gap (secure-aggregation systems at population scale
+treat dropout recovery as a first-class protocol phase — Bonawitz et al.,
+MLSys 2019): every aggregation round carries an explicit, store-persisted
+state machine, and a background sweeper drives the terminal transitions
+under configurable per-phase deadlines.
+
+States::
+
+    collecting --snapshot--> frozen --jobs enqueued--> clerking
+    clerking --all C results--> ready --reveal--> revealed        (terminal)
+    clerking --dead clerks, quorum reachable--> degraded --reveal--> revealed
+    clerking --dead clerks, quorum unreachable OR additive--> failed (terminal)
+    collecting/frozen --deadline--> expired                       (terminal)
+
+``ready`` means the FULL committee reported; ``degraded`` means the
+sweeper detected permanently dead clerks but the surviving quorum can
+(or already did) satisfy ``reconstruction_threshold``, so the existing
+quorum reconstruction (``crypto/sharing.py``) completes the round from
+survivors. Additive sharing cannot lose a single share
+(``reconstruction_threshold == committee size``), so a dead clerk
+transitions the round to ``failed`` with a machine-readable reason
+instead of hanging forever.
+
+Dead-clerk detection: past the clerking deadline, an undone clerking job
+with no ACTIVE lease (``leased_until <= now`` — lapsed, or never polled
+at all) marks its clerk dead. A slow-but-alive clerk always holds a live
+lease while working and is spared; a clerk that died holding a lease is
+detected one lease period after the deadline at the latest.
+
+Fleet safety: every transition is a store-arbitrated compare-and-swap
+(``transition_round_state`` on all four backends — the PR 6 single-winner
+conditional-write pattern), so in an N-worker fleet over one shared store
+exactly one worker performs each sweep action per round; the losers
+observe the winner's transition and move on.
+
+Observability: transitions count ``server.round.state.<state>``, sweep
+latency lands in the ``server.round.sweep`` histogram (``/metrics``),
+per-state gauges ride ``server.rounds.<state>``, transitions emit span
+events, and ``/statusz`` serves the rounds table (``rounds_report``).
+The recipient-facing view is ``GET /v1/aggregations/{id}/round``
+(:class:`~sda_tpu.protocol.RoundStatus`) and the blocking client call
+``SdaClient.await_result(deadline=...)``, which raises typed
+``RoundFailed`` / ``RoundExpired`` carrying the server's diagnosis.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .. import obs
+from ..utils import metrics
+from ..protocol import (
+    AdditiveSharing,
+    AggregationId,
+    RoundStatus,
+    SnapshotId,
+)
+
+log = logging.getLogger(__name__)
+
+#: Every state the machine can be in, in rough lifecycle order.
+STATES = (
+    "collecting", "frozen", "clerking", "ready", "revealed",
+    "degraded", "failed", "expired",
+)
+
+#: States no sweeper or protocol event ever leaves.
+TERMINAL_STATES = frozenset({"revealed", "failed", "expired"})
+
+#: Bounded transition history kept in the round document.
+_HISTORY_LIMIT = 16
+
+
+@dataclass
+class RoundDeadlines:
+    """Per-phase wall-clock budgets; ``None`` disables that deadline.
+
+    ``collecting_s``: aggregation creation -> snapshot (else ``expired``).
+    ``clerking_s``: job fan-out -> every result in; past it the sweeper
+    runs dead-clerk detection (``degraded`` / ``failed``) and expires
+    rounds stuck mid-snapshot (``frozen``).
+    """
+
+    collecting_s: Optional[float] = None
+    clerking_s: Optional[float] = None
+
+
+def scheme_kind(scheme) -> str:
+    """``"additive"`` (no share may be lost) vs ``"shamir"`` (any quorum
+    of ``reconstruction_threshold`` shares reconstructs)."""
+    return "additive" if isinstance(scheme, AdditiveSharing) else "shamir"
+
+
+def new_round_doc(aggregation, deadlines: Optional[RoundDeadlines]) -> dict:
+    """Fresh ``collecting`` record for a just-created aggregation. The
+    scheme facts the sweeper needs later (kind, committee size,
+    reconstruction threshold) are denormalized in so a sweep never has to
+    re-parse the aggregation resource."""
+    scheme = aggregation.committee_sharing_scheme
+    now = time.time()
+    deadline = None
+    if deadlines is not None and deadlines.collecting_s:
+        deadline = now + deadlines.collecting_s
+    return {
+        "aggregation": str(aggregation.id),
+        "state": "collecting",
+        "snapshot": None,
+        "scheme": scheme_kind(scheme),
+        "committee_size": int(scheme.output_size),
+        "reconstruction_threshold": int(scheme.reconstruction_threshold),
+        "dead_clerks": [],
+        "reason": None,
+        "deadline_at": deadline,
+        "updated_at": now,
+        "history": [["collecting", round(now, 3)]],
+    }
+
+
+def _advanced(doc: dict, state: str, *, snapshot=None, deadline_at=...,
+              reason=None, dead_clerks=None) -> dict:
+    """The successor document for a transition (pure; the CAS publishes)."""
+    now = time.time()
+    new = dict(doc)
+    new["state"] = state
+    if snapshot is not None:
+        new["snapshot"] = str(snapshot)
+    if deadline_at is not ...:
+        new["deadline_at"] = deadline_at
+    if reason is not None:
+        new["reason"] = reason
+    if dead_clerks is not None:
+        new["dead_clerks"] = [str(c) for c in dead_clerks]
+    new["updated_at"] = now
+    history = list(doc.get("history") or [])
+    history.append([state, round(now, 3)])
+    new["history"] = history[-_HISTORY_LIMIT:]
+    return new
+
+
+def transition(store, aggregation, from_states, state: str, **changes) -> bool:
+    """Store-arbitrated state transition: read the current document, build
+    the successor, publish with a conditional write keyed on the FROM
+    state. Exactly one of N racing workers wins (the fleet contract);
+    returns whether THIS call performed the transition."""
+    doc = store.get_round_state(aggregation)
+    if doc is None or doc.get("state") not in from_states:
+        return False
+    new = _advanced(doc, state, **changes)
+    if not store.transition_round_state(aggregation, from_states, new):
+        return False
+    metrics.count(f"server.round.state.{state}")
+    obs.add_event(f"round.{state}", aggregation=str(aggregation),
+                  previous=doc.get("state"))
+    return True
+
+
+# ---------------------------------------------------------------------------
+# protocol-event notes (called from server core / the snapshot pipeline)
+
+def note_collecting(server, aggregation) -> None:
+    """A fresh aggregation starts its round in ``collecting``.
+
+    Create-if-absent: ``create_aggregation`` is a retry-safe upsert
+    (``_IDEMPOTENT_POST_ROUTES``), so a replayed create after a lost
+    response must NOT reset an in-flight round back to collecting —
+    deleting the aggregation removes the record, so a genuinely new
+    aggregation always starts fresh."""
+    if server.aggregation_store.get_round_state(aggregation.id) is not None:
+        return
+    server.aggregation_store.put_round_state(
+        new_round_doc(aggregation, getattr(server, "round_deadlines", None)))
+    metrics.count("server.round.state.collecting")
+
+
+def note_frozen(server, aggregation, snapshot_id) -> None:
+    """The snapshot pipeline froze the participation set."""
+    store = server.aggregation_store
+    doc = store.get_round_state(aggregation.id)
+    if doc is None:
+        # pre-supervisor aggregation (or a store emptied under us): mint
+        # the record on the fly so the rest of the lifecycle is tracked
+        store.put_round_state(_advanced(
+            new_round_doc(aggregation, getattr(server, "round_deadlines",
+                                               None)),
+            "frozen", snapshot=snapshot_id, deadline_at=_clerking_deadline(
+                server)))
+        return
+    if doc["state"] in TERMINAL_STATES:
+        return  # terminal verdicts are never resurrected (a stale
+        # snapshot pipeline racing an expired round keeps the verdict)
+    if doc["state"] == "frozen" and doc.get("snapshot") == str(snapshot_id):
+        return  # replay of the same pipeline: already noted
+    transition(store, aggregation.id, (doc["state"],), "frozen",
+               snapshot=snapshot_id, deadline_at=_clerking_deadline(server))
+
+
+def _clerking_deadline(server) -> Optional[float]:
+    deadlines = getattr(server, "round_deadlines", None)
+    if deadlines is not None and deadlines.clerking_s:
+        return time.time() + deadlines.clerking_s
+    return None
+
+
+def note_clerking(server, aggregation_id, snapshot_id) -> None:
+    """The snapshot pipeline enqueued the clerking jobs: the round is
+    live for the committee (also re-entered by a later pipelined snapshot
+    of the same aggregation — the record tracks the current round)."""
+    store = server.aggregation_store
+    doc = store.get_round_state(aggregation_id)
+    if doc is None:
+        return  # nothing tracked for this aggregation; stay silent
+    if doc["state"] in TERMINAL_STATES:
+        return  # terminal verdicts are never resurrected
+    if doc["state"] == "clerking" and doc.get("snapshot") == str(snapshot_id):
+        return  # contended/replayed pipeline already converged here
+    transition(store, aggregation_id, (doc["state"],), "clerking",
+               snapshot=snapshot_id, deadline_at=_clerking_deadline(server))
+
+
+def note_result(server, job) -> None:
+    """A clerking result landed; when the FULL committee has reported the
+    round is ``ready`` (threshold-satisfying partial sets stay
+    ``clerking``/``degraded`` — ``result_ready`` is the recipient's
+    signal, ``ready`` is the everything-done state)."""
+    store = server.aggregation_store
+    doc = store.get_round_state(job.aggregation)
+    if (doc is None or doc.get("snapshot") != str(job.snapshot)
+            or doc["state"] != "clerking"):
+        return
+    results = len(server.clerking_job_store.list_results(job.snapshot))
+    if results >= int(doc.get("committee_size") or 0):
+        transition(store, job.aggregation, ("clerking",), "ready")
+
+
+def note_revealed(server, aggregation_id, snapshot_id, results: int) -> None:
+    """The recipient fetched a reconstruction-grade snapshot result."""
+    store = server.aggregation_store
+    doc = store.get_round_state(aggregation_id)
+    if doc is None or doc.get("snapshot") != str(snapshot_id):
+        return
+    if doc["state"] not in ("clerking", "ready", "degraded"):
+        return
+    if results >= int(doc.get("reconstruction_threshold") or 0):
+        transition(store, aggregation_id, (doc["state"],), "revealed")
+
+
+def round_status(server, aggregation_id) -> Optional[RoundStatus]:
+    """The recipient-facing view: the stored round document plus the LIVE
+    result count (never denormalized — it changes under the round)."""
+    doc = server.aggregation_store.get_round_state(aggregation_id)
+    if doc is None:
+        return None
+    results = 0
+    if doc.get("snapshot"):
+        results = len(server.clerking_job_store.list_results(
+            SnapshotId(doc["snapshot"])))
+    return RoundStatus(
+        aggregation=AggregationId(doc["aggregation"]),
+        state=doc["state"],
+        snapshot=SnapshotId(doc["snapshot"]) if doc.get("snapshot") else None,
+        scheme=doc.get("scheme"),
+        committee_size=doc.get("committee_size") or 0,
+        reconstruction_threshold=doc.get("reconstruction_threshold") or 0,
+        results=results,
+        dead_clerks=doc.get("dead_clerks") or [],
+        reason=doc.get("reason"),
+        deadline_at=doc.get("deadline_at"),
+        updated_at=doc.get("updated_at"),
+        history=doc.get("history") or [],
+    )
+
+
+def rounds_report(server, limit: int = 16) -> dict:
+    """The ``/statusz`` rounds table: per-state tallies plus the most
+    recently updated rounds (bounded — a long-lived server accumulates
+    terminal rounds)."""
+    docs = server.aggregation_store.list_round_states()
+    by_state: dict = {}
+    for doc in docs:
+        by_state[doc.get("state", "?")] = by_state.get(doc.get("state", "?"),
+                                                       0) + 1
+    recent = sorted(docs, key=lambda d: d.get("updated_at") or 0.0,
+                    reverse=True)[:limit]
+    return {
+        "count": len(docs),
+        "by_state": dict(sorted(by_state.items())),
+        "recent": [
+            {
+                "aggregation": d.get("aggregation"),
+                "state": d.get("state"),
+                "snapshot": d.get("snapshot"),
+                "reason": d.get("reason"),
+                "dead_clerks": d.get("dead_clerks") or None,
+                "updated_at": d.get("updated_at"),
+            }
+            for d in recent
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# the sweeper
+
+class RoundSweeper:
+    """Background deadline/dead-clerk sweeper for one ``sdad`` worker.
+
+    Every ``interval_s`` it lists the store's round records and, for each
+    non-terminal round past its phase deadline, performs the terminal
+    diagnosis — expired collection, stalled snapshot, dead clerks with
+    quorum-degraded completion or unrecoverable failure. All actions are
+    CAS transitions, so N workers sweeping one shared store perform each
+    action exactly once between them.
+    """
+
+    def __init__(self, server, interval_s: float = 1.0):
+        self.server = server
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RoundSweeper":
+        self._thread = threading.Thread(
+            target=self._run, name="round-sweeper", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sweep_once()
+            except Exception:  # the sweeper must outlive store hiccups
+                log.exception("round sweep failed; retrying next tick")
+                metrics.count("server.round.sweep_error")
+
+    def sweep_once(self, now: Optional[float] = None) -> dict:
+        """One sweep pass; returns ``{"rounds", "actions"}`` where each
+        action names a transition THIS worker won."""
+        now = time.time() if now is None else now
+        t0 = time.perf_counter()
+        actions: List[dict] = []
+        with obs.span("server.round.sweep") as sweep_span:
+            docs = self.server.aggregation_store.list_round_states()
+            by_state: dict = {}
+            for doc in docs:
+                state = doc.get("state", "?")
+                by_state[state] = by_state.get(state, 0) + 1
+            for state in STATES:
+                metrics.gauge_set(f"server.rounds.{state}",
+                                  by_state.get(state, 0))
+            for doc in docs:
+                if doc.get("state") in TERMINAL_STATES \
+                        or doc.get("state") == "ready":
+                    continue  # ready waits on the recipient, not on us
+                action = self._sweep_round(doc, now)
+                if action is not None:
+                    actions.append(action)
+                    obs.add_event("round.sweep_action", **action)
+            sweep_span.set_attribute("rounds", len(docs))
+            sweep_span.set_attribute("actions", len(actions))
+        metrics.observe("server.round.sweep", time.perf_counter() - t0)
+        return {"rounds": len(docs), "actions": actions}
+
+    # -- per-round diagnosis ------------------------------------------------
+    def _sweep_round(self, doc: dict, now: float) -> Optional[dict]:
+        deadline = doc.get("deadline_at")
+        if deadline is None or now < deadline:
+            return None
+        state = doc["state"]
+        aggregation = AggregationId(doc["aggregation"])
+        if state == "collecting":
+            reason = ("no snapshot within the collecting deadline "
+                      f"({doc['deadline_at']:.3f})")
+            if transition(self.server.aggregation_store, aggregation,
+                          ("collecting",), "expired", reason=reason):
+                return {"aggregation": str(aggregation), "to": "expired",
+                        "reason": reason}
+            return None
+        if state == "frozen":
+            reason = ("snapshot pipeline stalled past the clerking "
+                      "deadline (frozen set installed, jobs never "
+                      "enqueued)")
+            if transition(self.server.aggregation_store, aggregation,
+                          ("frozen",), "expired", reason=reason):
+                return {"aggregation": str(aggregation), "to": "expired",
+                        "reason": reason}
+            return None
+        if state in ("clerking", "degraded"):
+            return self._sweep_clerking(doc, aggregation, now)
+        return None
+
+    def _sweep_clerking(self, doc: dict, aggregation,
+                        now: float) -> Optional[dict]:
+        """Dead-clerk detection past the clerking deadline. A job is dead
+        when undone with no ACTIVE lease — lapsed (the clerk died holding
+        it, past reissue) or never polled at all (the clerk never showed
+        up); an actively leased job means someone is working right now."""
+        snapshot = SnapshotId(doc["snapshot"])
+        jobs = self.server.clerking_job_store.list_snapshot_jobs(snapshot)
+        if not jobs:
+            return None  # backend cannot enumerate: no diagnosis possible
+        dead = sorted(
+            str(clerk)
+            for (_job, clerk, done, leased_until) in jobs
+            if not done and leased_until <= now
+        )
+        if not dead:
+            return None  # every missing job is actively leased: alive
+        results = len(self.server.clerking_job_store.list_results(snapshot))
+        threshold = int(doc.get("reconstruction_threshold") or 0)
+        committee = int(doc.get("committee_size") or len(jobs))
+        reachable = committee - len(dead)
+        if doc.get("scheme") == "additive":
+            to = "failed"
+            reason = (f"additive sharing cannot recover {len(dead)} dead "
+                      f"clerk(s): every share is required "
+                      f"(reconstruction_threshold == committee size "
+                      f"{committee})")
+        elif reachable >= threshold or results >= threshold:
+            to = "degraded"
+            reason = (f"{len(dead)} dead clerk(s) detected past the "
+                      f"clerking deadline; completing from the surviving "
+                      f"quorum ({max(reachable, results)} >= "
+                      f"reconstruction threshold {threshold})")
+        else:
+            to = "failed"
+            reason = (f"quorum unreachable: {len(dead)} dead clerk(s) "
+                      f"leave at most {reachable} results, below the "
+                      f"reconstruction threshold {threshold}")
+        if doc["state"] == "degraded" and to == "degraded":
+            return None  # already diagnosed; nothing new to record
+        if transition(self.server.aggregation_store, aggregation,
+                      (doc["state"],), to, reason=reason, dead_clerks=dead):
+            metrics.count("server.round.dead_clerks", len(dead))
+            log.warning("round %s -> %s: %s", aggregation, to, reason)
+            return {"aggregation": str(aggregation), "to": to,
+                    "reason": reason, "dead_clerks": dead}
+        return None

@@ -117,6 +117,7 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
         self._snapshots: Dict[AggregationId, OrderedDict] = {}
         self._snapshot_parts: Dict[SnapshotId, List[ParticipationId]] = {}
         self._snapshot_masks = {}
+        self._rounds: Dict[str, dict] = {}  # aggregation id str -> doc
 
     def list_aggregations(self, filter=None, recipient=None):
         with self._lock:
@@ -144,6 +145,7 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
             self._aggregations.pop(aggregation, None)
             self._committees.pop(aggregation, None)
             self._participations.pop(aggregation, None)
+            self._rounds.pop(str(aggregation), None)
             for sid in self._snapshots.pop(aggregation, OrderedDict()):
                 self._snapshot_parts.pop(sid, None)
                 self._snapshot_masks.pop(sid, None)
@@ -208,6 +210,31 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
             part_ids = self._snapshot_parts.get(snapshot, [])
             parts = self._participations.get(aggregation, OrderedDict())
             return [parts[pid] for pid in part_ids if pid in parts]
+
+    # -- round lifecycle ----------------------------------------------------
+    def put_round_state(self, doc):
+        with self._lock:
+            self._rounds[doc["aggregation"]] = dict(doc)
+
+    def get_round_state(self, aggregation):
+        with self._lock:
+            doc = self._rounds.get(str(aggregation))
+            return None if doc is None else dict(doc)
+
+    def list_round_states(self):
+        with self._lock:
+            return [dict(d) for d in self._rounds.values()]
+
+    def transition_round_state(self, aggregation, from_states, doc):
+        # single-winner CAS: the state check + publish under one lock is
+        # the arbiter (same contract the sqlite/jsonfs/mongo stores keep
+        # across OS processes)
+        with self._lock:
+            current = self._rounds.get(str(aggregation))
+            if current is None or current.get("state") not in from_states:
+                return False
+            self._rounds[str(aggregation)] = dict(doc)
+            return True
 
     def create_snapshot_mask(self, snapshot, mask):
         with self._lock:
@@ -280,6 +307,22 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
                 return False
             del self._leases[job]
             return True
+
+    def list_snapshot_jobs(self, snapshot):
+        # the sweeper's dead-clerk census: queued jobs with their lease
+        # expiry, done jobs flagged done (lease irrelevant once complete)
+        with self._lock:
+            out = []
+            for clerk, queue in self._queues.items():
+                for job in queue.values():
+                    if str(job.snapshot) == str(snapshot):
+                        out.append((job.id, clerk, False,
+                                    float(self._leases.get(job.id, 0.0))))
+            for clerk, done in self._done.items():
+                for job in done.values():
+                    if str(job.snapshot) == str(snapshot):
+                        out.append((job.id, clerk, True, 0.0))
+            return sorted(out, key=lambda entry: str(entry[0]))
 
     def get_clerking_job(self, clerk, job):
         with self._lock:

@@ -177,6 +177,7 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         self.db.participations.delete_many({"aggregation": agg})
         self.db.snapshots.delete_many({"aggregation": agg})
         self.db.committees.delete_one({"_id": agg})
+        self.db.rounds.delete_one({"_id": agg})
         self.db.aggregations.delete_one({"_id": agg})
 
     def get_committee(self, aggregation):
@@ -297,6 +298,30 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
             Participation.from_obj(d["doc"]) for d in cursor.sort("_id", 1)
         ]
 
+    # -- round lifecycle ----------------------------------------------------
+    def put_round_state(self, doc):
+        self.db.rounds.replace_one(
+            {"_id": doc["aggregation"]},
+            {"_id": doc["aggregation"], "state": doc["state"], "doc": doc},
+            upsert=True,
+        )
+
+    def get_round_state(self, aggregation):
+        found = self.db.rounds.find_one({"_id": str(aggregation)})
+        return None if found is None else found["doc"]
+
+    def list_round_states(self):
+        return [d["doc"] for d in self.db.rounds.find({}).sort("_id", 1)]
+
+    def transition_round_state(self, aggregation, from_states, doc):
+        # single-winner CAS: one atomic find_one_and_update filtered on
+        # the FROM state — N sweeping workers race, exactly one matches
+        found = self.db.rounds.find_one_and_update(
+            {"_id": str(aggregation), "state": {"$in": list(from_states)}},
+            {"$set": {"state": doc["state"], "doc": doc}},
+        )
+        return found is not None
+
     def create_snapshot_mask(self, snapshot, mask):
         self.db.snapshot_masks.replace_one(
             {"_id": str(snapshot)},
@@ -412,6 +437,20 @@ class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
             {"$set": {"leased_until": 0}},
         )
         return result.matched_count > 0
+
+    def list_snapshot_jobs(self, snapshot):
+        # the sweeper's dead-clerk census: only the queue metadata fields
+        # are decoded (the embedded payload/result docs stay untouched)
+        out = []
+        for d in self.db.clerking_jobs.find(
+                {"snapshot": str(snapshot)}).sort("_id", 1):
+            out.append((
+                ClerkingJobId(d["_id"]),
+                AgentId(d["clerk"]),
+                bool(d.get("done")),
+                float(d.get("leased_until") or 0.0),
+            ))
+        return out
 
     def get_clerking_job(self, clerk, job):
         doc = self.db.clerking_jobs.find_one({"_id": str(job), "clerk": str(clerk)})

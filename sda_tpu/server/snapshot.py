@@ -33,6 +33,7 @@ from ..protocol import (
 )
 from .. import obs
 from ..utils import metrics, timed_phase
+from . import lifecycle
 
 log = logging.getLogger(__name__)
 
@@ -116,6 +117,9 @@ def _snapshot_locked(server, snap: Snapshot) -> bool:
             log.debug("snapshot %s: freeze already installed (replay or "
                       "competing worker); converging on it", snap.id)
             metrics.count("server.snapshot.freeze_converged")
+    # lifecycle: the round leaves collecting the moment its participation
+    # set is frozen (CAS — contended pipelines note it exactly once)
+    lifecycle.note_frozen(server, aggregation, snap.id)
 
     committee = server.get_committee(snap.aggregation)
     if committee is None:
@@ -159,6 +163,9 @@ def _snapshot_locked(server, snap: Snapshot) -> bool:
         # transaction on sqlite, one lock hold on memory/jsonfs, batched
         # round trips on mongo) instead of C commits of C full columns
         server.clerking_job_store.enqueue_clerking_jobs(jobs)
+    # lifecycle: jobs are durable, the committee can work — the round is
+    # clerking and its deadline clock starts (lifecycle.py)
+    lifecycle.note_clerking(server, snap.aggregation, snap.id)
 
     if aggregation.masking_scheme.has_mask:
         log.debug("snapshot %s: collecting recipient mask encryptions", snap.id)

@@ -99,6 +99,8 @@ CREATE INDEX IF NOT EXISTS ix_jobs_queue ON clerking_jobs (clerk, done, id);
 CREATE TABLE IF NOT EXISTS clerking_results (
     job TEXT NOT NULL, snapshot TEXT NOT NULL, doc TEXT NOT NULL,
     PRIMARY KEY (snapshot, job));
+CREATE TABLE IF NOT EXISTS rounds (
+    aggregation TEXT PRIMARY KEY, state TEXT NOT NULL, doc TEXT NOT NULL);
 """
 
 
@@ -315,6 +317,7 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
             )
             self.db.conn.execute("DELETE FROM snapshots WHERE aggregation = ?", (agg,))
             self.db.conn.execute("DELETE FROM committees WHERE aggregation = ?", (agg,))
+            self.db.conn.execute("DELETE FROM rounds WHERE aggregation = ?", (agg,))
             self.db.conn.execute("DELETE FROM aggregations WHERE id = ?", (agg,))
 
     def get_committee(self, aggregation):
@@ -461,6 +464,38 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
             out.append(None if enc is None else Encryption.from_obj(enc))
         return out
 
+    # -- round lifecycle ----------------------------------------------------
+    def put_round_state(self, doc):
+        self._exec(
+            "INSERT INTO rounds (aggregation, state, doc) VALUES (?, ?, ?) "
+            "ON CONFLICT (aggregation) DO UPDATE SET "
+            "state = excluded.state, doc = excluded.doc",
+            (doc["aggregation"], doc["state"], json.dumps(doc)),
+        )
+
+    def get_round_state(self, aggregation):
+        row = self._one(
+            "SELECT doc FROM rounds WHERE aggregation = ?", (str(aggregation),)
+        )
+        return None if row is None else json.loads(row[0])
+
+    def list_round_states(self):
+        rows = self._all("SELECT doc FROM rounds ORDER BY aggregation")
+        return [json.loads(r[0]) for r in rows]
+
+    def transition_round_state(self, aggregation, from_states, doc):
+        # single-winner CAS across OS processes: ONE conditional UPDATE —
+        # autocommit makes it its own transaction, rowcount says whether
+        # THIS worker's sweep performed the transition (fleet contract,
+        # same shape as the snapshot-freeze conditional insert)
+        from_states = tuple(str(s) for s in from_states)
+        cursor = self._exec(
+            "UPDATE rounds SET state = ?, doc = ? WHERE aggregation = ? "
+            f"AND state IN ({','.join('?' * len(from_states))})",
+            (doc["state"], json.dumps(doc), str(aggregation), *from_states),
+        )
+        return cursor.rowcount > 0
+
     def create_snapshot_mask(self, snapshot, mask):
         self._exec(
             "INSERT INTO snapshot_masks (snapshot, doc) VALUES (?, ?) "
@@ -572,6 +607,19 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
             args.append(expires)
         cursor = self._exec(sql, tuple(args))
         return cursor.rowcount > 0
+
+    def list_snapshot_jobs(self, snapshot):
+        # the sweeper's dead-clerk census: one indexed-column read, no
+        # payload decode (the doc column never leaves the database)
+        rows = self._all(
+            "SELECT id, clerk, done, leased_until FROM clerking_jobs "
+            "WHERE snapshot = ? ORDER BY id",
+            (str(snapshot),),
+        )
+        return [
+            (ClerkingJobId(r[0]), AgentId(r[1]), bool(r[2]), float(r[3]))
+            for r in rows
+        ]
 
     def get_clerking_job(self, clerk, job):
         row = self._one(

@@ -201,6 +201,44 @@ class AggregationsStore(BaseStore):
     @abc.abstractmethod
     def get_snapshot_mask(self, snapshot: SnapshotId) -> Optional[List[Encryption]]: ...
 
+    # -- round lifecycle (server/lifecycle.py) ------------------------------
+    # The four in-repo backends override all of these with durable,
+    # contended-safe implementations; the base fallbacks below keep
+    # third-party stores working (in-memory, NOT crash- or fleet-safe).
+
+    def _fallback_rounds(self) -> dict:
+        rounds = getattr(self, "_base_rounds", None)
+        if rounds is None:
+            rounds = self._base_rounds = {}
+        return rounds
+
+    def put_round_state(self, doc: dict) -> None:
+        """Unconditionally upsert a round lifecycle document (keyed by its
+        ``doc["aggregation"]`` id string)."""
+        self._fallback_rounds()[doc["aggregation"]] = dict(doc)
+
+    def get_round_state(self, aggregation: AggregationId) -> Optional[dict]:
+        doc = self._fallback_rounds().get(str(aggregation))
+        return None if doc is None else dict(doc)
+
+    def list_round_states(self) -> List[dict]:
+        return [dict(d) for d in self._fallback_rounds().values()]
+
+    def transition_round_state(
+        self, aggregation: AggregationId, from_states, doc: dict
+    ) -> bool:
+        """Conditional publish: install ``doc`` iff the stored record's
+        current ``state`` is one of ``from_states`` — the single-winner
+        CAS that lets N fleet workers race a lifecycle transition and
+        guarantees exactly one performs it (the same conditional-write
+        contract as ``create_snapshot``; docs/robustness.md)."""
+        rounds = self._fallback_rounds()
+        current = rounds.get(str(aggregation))
+        if current is None or current.get("state") not in from_states:
+            return False
+        rounds[str(aggregation)] = dict(doc)
+        return True
+
 
 class ClerkingJobsStore(BaseStore):
     @abc.abstractmethod
@@ -267,6 +305,18 @@ class ClerkingJobsStore(BaseStore):
 
     @abc.abstractmethod
     def create_clerking_result(self, result: ClerkingResult) -> None: ...
+
+    def list_snapshot_jobs(
+        self, snapshot: SnapshotId
+    ) -> List[Tuple[ClerkingJobId, AgentId, bool, float]]:
+        """Every clerking job of the snapshot as ``(job id, clerk, done,
+        leased_until)`` — the round sweeper's dead-clerk census
+        (``server/lifecycle.py``): past the clerking deadline, an undone
+        job with no active lease (``leased_until <= now``) marks its
+        clerk dead. ``leased_until`` is 0 for never-leased jobs and on
+        backends without lease support. The base fallback returns ``[]``
+        (no census possible → the sweeper stays silent)."""
+        return []
 
     @abc.abstractmethod
     def list_results(self, snapshot: SnapshotId) -> List[ClerkingJobId]: ...

@@ -1,0 +1,711 @@
+"""Round lifecycle supervisor: state machine, deadline sweeper, dead-clerk
+detection, quorum-degraded completion, and the typed client surface.
+
+The contract under test (``sda_tpu/server/lifecycle.py``,
+docs/robustness.md): every aggregation round carries an explicit
+store-persisted state machine (``collecting → frozen → clerking → ready →
+revealed`` plus terminal ``degraded``/``failed``/``expired``); the sweeper
+diagnoses permanently dead clerks past the clerking deadline — Shamir
+rounds degrade to the surviving quorum and still reveal bit-exactly,
+additive rounds fail closed with a machine-readable reason; and every
+sweep action is a store-arbitrated CAS, so two fleet workers over one
+shared backend perform each transition exactly once.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sda_tpu import chaos, obs
+from sda_tpu.client import SdaClient
+from sda_tpu.crypto import MemoryKeystore, sodium
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    ClerkingResult,
+    Committee,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+    Participation,
+    ParticipationId,
+    RoundExpired,
+    RoundFailed,
+    RoundStatus,
+    Snapshot,
+    SnapshotId,
+    SodiumEncryption,
+)
+from sda_tpu.server import (
+    new_jsonfs_server,
+    new_memory_server,
+    new_mongo_server,
+    new_sqlite_server,
+)
+from sda_tpu.server import lifecycle
+from sda_tpu.utils import metrics
+
+from util import mock_encryption, new_agent, new_full_agent
+
+GOLDEN = PackedShamirSharing(
+    secret_count=3, share_count=8, privacy_threshold=4,
+    prime_modulus=433, omega_secrets=354, omega_shares=150,
+)
+
+needs_sodium = pytest.mark.skipif(not sodium.available(),
+                                  reason="libsodium not present")
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    obs.reset_all()
+    chaos.reset()
+    yield
+    chaos.reset()
+    obs.reset_all()
+
+
+def _mock_world(service, scheme, participants=3):
+    """Mock-crypto aggregation with a committee and frozen snapshot jobs
+    (the server never opens ciphertexts, so state-machine tests don't
+    need libsodium)."""
+    recipient, rkey = new_full_agent(service)
+    committee = [new_full_agent(service) for _ in range(scheme.output_size)]
+    agg = Aggregation(
+        id=AggregationId.random(), title="lifecycle", vector_dimension=4,
+        modulus=433, recipient=recipient.id, recipient_key=rkey.body.id,
+        masking_scheme=NoMasking(), committee_sharing_scheme=scheme,
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    service.create_aggregation(recipient, agg)
+    service.create_committee(recipient, Committee(
+        aggregation=agg.id,
+        clerks_and_keys=[(a.id, k.body.id) for (a, k) in committee],
+    ))
+    for i in range(participants):
+        agent = new_agent()
+        service.create_agent(agent, agent)
+        service.create_participation(agent, Participation(
+            id=ParticipationId.random(), participant=agent.id,
+            aggregation=agg.id, recipient_encryption=None,
+            clerk_encryptions=[(a.id, mock_encryption(bytes([i])))
+                               for (a, _) in committee],
+        ))
+    return recipient, committee, agg
+
+
+def _post_results(service, committee):
+    for (agent, _key) in committee:
+        job = service.get_clerking_job(agent, agent.id)
+        service.create_clerking_result(agent, ClerkingResult(
+            job=job.id, clerk=agent.id, encryption=mock_encryption(b"r")))
+
+
+# ---------------------------------------------------------------------------
+# the state machine over protocol events
+
+def test_happy_path_states():
+    service = new_memory_server()
+    recipient, committee, agg = _mock_world(service, AdditiveSharing(3, 433))
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "collecting"
+    assert status.scheme == "additive"
+    assert status.committee_size == 3
+    assert status.reconstruction_threshold == 3
+
+    snap = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+    service.create_snapshot(recipient, snap)
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "clerking"
+    assert status.snapshot == snap.id
+    assert [s for s, _ in status.history] == ["collecting", "frozen",
+                                              "clerking"]
+
+    _post_results(service, committee)
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "ready"
+    assert status.results == 3
+
+    service.get_snapshot_result(recipient, agg.id, snap.id)
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "revealed"
+    # history timestamps are monotone non-decreasing server stamps
+    stamps = [ts for _, ts in status.history]
+    assert stamps == sorted(stamps)
+
+
+def test_partial_results_stay_clerking():
+    """reconstruction_threshold results make result_ready true but the
+    round stays clerking — ready means the FULL committee reported; only
+    the sweeper may declare the stragglers dead."""
+    service = new_memory_server()
+    recipient, committee, agg = _mock_world(service, GOLDEN)
+    service.create_snapshot(
+        recipient, Snapshot(id=SnapshotId.random(), aggregation=agg.id))
+    _post_results(service, committee[:GOLDEN.reconstruction_threshold])
+    status = service.get_round_status(recipient, agg.id)
+    assert status.results == GOLDEN.reconstruction_threshold
+    assert status.state == "clerking"
+
+
+def test_replayed_create_aggregation_does_not_reset_round():
+    """create_aggregation is a retry-safe upsert: a replayed create after
+    a lost response must not snap an in-flight round back to collecting
+    (which would erase its snapshot/diagnosis and let a collect deadline
+    expire a live round)."""
+    service = new_memory_server()
+    recipient, committee, agg = _mock_world(service, AdditiveSharing(3, 433))
+    snap = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+    service.create_snapshot(recipient, snap)
+    assert service.get_round_status(recipient, agg.id).state == "clerking"
+    service.create_aggregation(recipient, agg)  # the client's retry
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "clerking"
+    assert status.snapshot == snap.id
+    # deleting really does start over
+    service.delete_aggregation(recipient, agg.id)
+    service.create_aggregation(recipient, agg)
+    assert service.get_round_status(recipient, agg.id).state == "collecting"
+
+
+def test_stale_snapshot_cannot_resurrect_terminal_round():
+    """A snapshot pipeline racing an already-expired round must not pull
+    it back to frozen/clerking: terminal verdicts the client was already
+    told stay terminal."""
+    service = new_memory_server()
+    service.server.round_deadlines = lifecycle.RoundDeadlines(
+        collecting_s=0.5)
+    recipient, _, agg = _mock_world(service, AdditiveSharing(3, 433))
+    sweeper = lifecycle.RoundSweeper(service.server)
+    sweeper.sweep_once(now=time.time() + 10)
+    assert service.get_round_status(recipient, agg.id).state == "expired"
+    # the delayed snapshot still runs (nothing blocks it protocol-side)...
+    service.create_snapshot(
+        recipient, Snapshot(id=SnapshotId.random(), aggregation=agg.id))
+    # ...but the round's verdict is unchanged
+    assert service.get_round_status(recipient, agg.id).state == "expired"
+
+
+def test_round_status_roundtrip():
+    status = RoundStatus(
+        aggregation=AggregationId.random(), state="degraded",
+        snapshot=SnapshotId.random(), scheme="shamir", committee_size=8,
+        reconstruction_threshold=7, results=7,
+        dead_clerks=[AgentId.random()], reason="r", deadline_at=1.5,
+        updated_at=2.5, history=[["clerking", 1.0], ["degraded", 2.5]],
+    )
+    assert RoundStatus.from_obj(status.to_obj()) == status
+
+
+# ---------------------------------------------------------------------------
+# the sweeper: deadlines + dead-clerk diagnosis
+
+def test_sweeper_expires_collecting_past_deadline():
+    service = new_memory_server()
+    service.server.round_deadlines = lifecycle.RoundDeadlines(
+        collecting_s=0.5)
+    recipient, _, agg = _mock_world(service, AdditiveSharing(3, 433))
+    sweeper = lifecycle.RoundSweeper(service.server)
+    assert sweeper.sweep_once(now=time.time())["actions"] == []
+    summary = sweeper.sweep_once(now=time.time() + 10)
+    assert [a["to"] for a in summary["actions"]] == ["expired"]
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "expired"
+    assert "collecting deadline" in status.reason
+    # terminal: a later sweep never acts again
+    assert sweeper.sweep_once(now=time.time() + 20)["actions"] == []
+
+
+def test_sweeper_shamir_dead_clerk_degrades_then_reveals():
+    service = new_memory_server()
+    service.server.round_deadlines = lifecycle.RoundDeadlines(clerking_s=0.5)
+    recipient, committee, agg = _mock_world(service, GOLDEN)
+    snap = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+    service.create_snapshot(recipient, snap)
+    dead_clerk = committee[0][0]
+    _post_results(service, committee[1:])  # 7 of 8 == threshold
+
+    sweeper = lifecycle.RoundSweeper(service.server)
+    summary = sweeper.sweep_once(now=time.time() + 10)
+    assert [a["to"] for a in summary["actions"]] == ["degraded"]
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "degraded"
+    assert [str(c) for c in status.dead_clerks] == [str(dead_clerk.id)]
+    assert "surviving quorum" in status.reason
+    # the reveal completes the degraded round: clerking→degraded→revealed
+    service.get_snapshot_result(recipient, agg.id, snap.id)
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "revealed"
+    assert [s for s, _ in status.history][-3:] == ["clerking", "degraded",
+                                                   "revealed"]
+
+
+def test_sweeper_additive_dead_clerk_fails_closed():
+    service = new_memory_server()
+    service.server.round_deadlines = lifecycle.RoundDeadlines(clerking_s=0.5)
+    recipient, committee, agg = _mock_world(service, AdditiveSharing(4, 433))
+    service.create_snapshot(
+        recipient, Snapshot(id=SnapshotId.random(), aggregation=agg.id))
+    _post_results(service, committee[1:])  # 3 of 4: unrecoverable
+
+    sweeper = lifecycle.RoundSweeper(service.server)
+    summary = sweeper.sweep_once(now=time.time() + 10)
+    assert [a["to"] for a in summary["actions"]] == ["failed"]
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "failed"
+    assert "additive sharing cannot recover" in status.reason
+    # zero admitted participations were lost on the way to the verdict
+    assert service.server.aggregation_store.count_participations(agg.id) == 3
+
+
+def test_sweeper_quorum_unreachable_fails():
+    service = new_memory_server()
+    service.server.round_deadlines = lifecycle.RoundDeadlines(clerking_s=0.5)
+    recipient, committee, agg = _mock_world(service, GOLDEN)
+    service.create_snapshot(
+        recipient, Snapshot(id=SnapshotId.random(), aggregation=agg.id))
+    _post_results(service, committee[2:])  # 6 of 8 < threshold 7, 2 dead
+
+    sweeper = lifecycle.RoundSweeper(service.server)
+    summary = sweeper.sweep_once(now=time.time() + 10)
+    assert [a["to"] for a in summary["actions"]] == ["failed"]
+    status = service.get_round_status(recipient, agg.id)
+    assert status.state == "failed"
+    assert "quorum unreachable" in status.reason
+    assert len(status.dead_clerks) == 2
+
+
+def test_sweeper_spares_actively_leased_jobs():
+    """An undone job under a LIVE lease means its clerk is working right
+    now: no dead-clerk verdict, even past the clerking deadline."""
+    service = new_memory_server()
+    service.server.round_deadlines = lifecycle.RoundDeadlines(clerking_s=0.5)
+    service.server.clerking_lease_seconds = 3600.0  # nobody expires today
+    recipient, committee, agg = _mock_world(service, AdditiveSharing(3, 433))
+    service.create_snapshot(
+        recipient, Snapshot(id=SnapshotId.random(), aggregation=agg.id))
+    _post_results(service, committee[1:])
+    # the remaining clerk POLLS (stamping a one-hour lease) but has not
+    # posted its result yet — slow, not dead
+    slow_agent = committee[0][0]
+    assert service.get_clerking_job(slow_agent, slow_agent.id) is not None
+    sweeper = lifecycle.RoundSweeper(service.server)
+    assert sweeper.sweep_once(now=time.time() + 10)["actions"] == []
+    assert service.get_round_status(recipient, agg.id).state == "clerking"
+
+
+def test_no_deadline_means_no_sweeper_action():
+    """Default deadlines (all None): states are tracked but nothing ever
+    expires — bit-compatible with the pre-supervisor server."""
+    service = new_memory_server()
+    recipient, committee, agg = _mock_world(service, GOLDEN)
+    service.create_snapshot(
+        recipient, Snapshot(id=SnapshotId.random(), aggregation=agg.id))
+    sweeper = lifecycle.RoundSweeper(service.server)
+    assert sweeper.sweep_once(now=time.time() + 1e6)["actions"] == []
+    assert service.get_round_status(recipient, agg.id).state == "clerking"
+
+
+def test_sweep_metrics_and_statusz_rounds_table():
+    service = new_memory_server()
+    service.server.round_deadlines = lifecycle.RoundDeadlines(clerking_s=0.5)
+    recipient, committee, agg = _mock_world(service, AdditiveSharing(4, 433))
+    service.create_snapshot(
+        recipient, Snapshot(id=SnapshotId.random(), aggregation=agg.id))
+    _post_results(service, committee[1:])
+    sweeper = lifecycle.RoundSweeper(service.server)
+    sweeper.sweep_once(now=time.time() + 10)
+    # sweep latency histogram (exposed on /metrics) + transition counters
+    assert metrics.histogram_report("server.round.sweep")[
+        "server.round.sweep"]["count"] >= 1
+    counters = metrics.counter_report("server.round.")
+    assert counters["server.round.state.failed"] == 1
+    assert counters["server.round.dead_clerks"] == 1
+    report = lifecycle.rounds_report(service.server)
+    assert report["count"] == 1
+    assert report["by_state"] == {"failed": 1}
+    assert report["recent"][0]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# fleet arbitration: exactly one worker wins each sweep action
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite", "jsonfs",
+                                     "fakemongo"])
+def test_sweep_single_winner_across_two_handles(backend, tmp_path):
+    if backend == "memory":
+        from sda_tpu.server import SdaServerService
+        from sda_tpu.server.core import SdaServer
+        from sda_tpu.server.memory import (
+            MemoryAggregationsStore,
+            MemoryAgentsStore,
+            MemoryAuthTokensStore,
+            MemoryClerkingJobsStore,
+        )
+
+        stores = dict(
+            agents_store=MemoryAgentsStore(),
+            auth_tokens_store=MemoryAuthTokensStore(),
+            aggregation_store=MemoryAggregationsStore(),
+            clerking_job_store=MemoryClerkingJobsStore(),
+        )
+        a, b = SdaServerService(SdaServer(**stores)), \
+            SdaServerService(SdaServer(**stores))
+    elif backend == "sqlite":
+        path = tmp_path / "shared.db"
+        a, b = new_sqlite_server(path), new_sqlite_server(path)
+    elif backend == "jsonfs":
+        root = tmp_path / "shared-jfs"
+        a, b = new_jsonfs_server(root), new_jsonfs_server(root)
+    else:
+        from fake_mongo import FakeDatabase
+
+        db = FakeDatabase()
+        a, b = new_mongo_server(db), new_mongo_server(db)
+    for handle in (a, b):
+        handle.server.round_deadlines = lifecycle.RoundDeadlines(
+            clerking_s=0.5)
+
+    recipient, committee, agg = _mock_world(a, GOLDEN)
+    a.create_snapshot(
+        recipient, Snapshot(id=SnapshotId.random(), aggregation=agg.id))
+    _post_results(b, committee[1:])  # results through the PEER handle
+
+    now = time.time() + 10
+    results = [None, None]
+    sweepers = [lifecycle.RoundSweeper(a.server),
+                lifecycle.RoundSweeper(b.server)]
+
+    def sweep(ix):
+        results[ix] = sweepers[ix].sweep_once(now=now)
+
+    threads = [threading.Thread(target=sweep, args=(ix,)) for ix in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    actions = results[0]["actions"] + results[1]["actions"]
+    assert [a_["to"] for a_ in actions] == ["degraded"]  # exactly one winner
+    # both handles observe the winner's transition, and zero
+    # participations were lost along the way
+    for handle in (a, b):
+        assert handle.server.get_round_status(agg.id).state == "degraded"
+        assert handle.server.aggregation_store.count_participations(
+            agg.id) == 3
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface
+
+def test_round_status_over_http_and_acl():
+    from sda_tpu.http import SdaHttpClient, SdaHttpServer
+    from sda_tpu.protocol import PermissionDenied
+
+    service = new_memory_server()
+    http_server = SdaHttpServer(service, bind="127.0.0.1:0",
+                                statusz_endpoint=True)
+    http_server.start_background()
+    try:
+        proxy = SdaHttpClient(http_server.address, token="lifecycle-test")
+        recipient = new_agent()
+        proxy.create_agent(recipient, recipient)
+        stranger = new_agent()
+        proxy.create_agent(stranger, stranger)
+        key = new_full_agent(service)[1]  # key via the in-process seam
+        agg = Aggregation(
+            id=AggregationId.random(), title="http", vector_dimension=4,
+            modulus=433, recipient=recipient.id,
+            recipient_key=key.body.id, masking_scheme=NoMasking(),
+            committee_sharing_scheme=AdditiveSharing(2, 433),
+            recipient_encryption_scheme=SodiumEncryption(),
+            committee_encryption_scheme=SodiumEncryption(),
+        )
+        proxy.create_aggregation(recipient, agg)
+        status = proxy.get_round_status(recipient, agg.id)
+        assert isinstance(status, RoundStatus)
+        assert status.state == "collecting"
+        assert status.aggregation == agg.id
+        # recipient-only: the diagnosis names dead clerks
+        with pytest.raises(PermissionDenied):
+            proxy.get_round_status(stranger, agg.id)
+        # the /statusz rounds table serves the same store-wide view
+        statusz = http_server.statusz()
+        assert statusz["rounds"]["by_state"] == {"collecting": 1}
+    finally:
+        http_server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the blocking client: await_result + typed failures
+
+def test_await_result_raises_typed_round_failed():
+    service = new_memory_server()
+    recipient, committee, agg = _mock_world(service, AdditiveSharing(3, 433))
+    dead = str(committee[0][0].id)
+    service.server.aggregation_store.put_round_state({
+        "aggregation": str(agg.id), "state": "failed", "snapshot": None,
+        "scheme": "additive", "committee_size": 3,
+        "reconstruction_threshold": 3, "dead_clerks": [dead],
+        "reason": "boom", "deadline_at": None, "updated_at": time.time(),
+        "history": [["failed", time.time()]],
+    })
+    client = SdaClient(recipient, MemoryKeystore(), service)
+    with pytest.raises(RoundFailed) as err:
+        client.await_result(agg.id, deadline=5.0)
+    assert err.value.reason == "boom"
+    assert err.value.state == "failed"
+    assert [str(c) for c in err.value.dead_clerks] == [dead]
+    assert not isinstance(err.value, RoundExpired)
+
+
+def test_await_result_expired_round_raises_round_expired():
+    service = new_memory_server()
+    recipient, _, agg = _mock_world(service, AdditiveSharing(3, 433))
+    service.server.aggregation_store.put_round_state({
+        "aggregation": str(agg.id), "state": "expired", "snapshot": None,
+        "scheme": "additive", "committee_size": 3,
+        "reconstruction_threshold": 3, "dead_clerks": [],
+        "reason": "took too long", "deadline_at": None,
+        "updated_at": time.time(), "history": [],
+    })
+    client = SdaClient(recipient, MemoryKeystore(), service)
+    with pytest.raises(RoundExpired, match="took too long"):
+        client.await_result(agg.id)
+
+
+def test_await_result_client_deadline():
+    service = new_memory_server()
+    recipient, _, agg = _mock_world(service, AdditiveSharing(3, 433))
+    client = SdaClient(recipient, MemoryKeystore(), service)
+    t0 = time.monotonic()
+    with pytest.raises(RoundExpired, match="client-side"):
+        client.await_result(agg.id, deadline=0.3, poll_interval=0.05)
+    assert time.monotonic() - t0 < 5.0
+
+
+@needs_sodium
+def test_await_result_returns_output():
+    """The success path: a straggler clerk finishes in the background and
+    the blocked recipient wakes up with the bit-exact aggregate."""
+    service = new_memory_server()
+
+    def new_client():
+        keystore = MemoryKeystore()
+        client = SdaClient(SdaClient.new_agent(keystore), keystore, service)
+        client.upload_agent()
+        return client
+
+    recipient = new_client()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    clerks = []
+    for _ in range(2):
+        clerk = new_client()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+        clerks.append(clerk)
+    agg = Aggregation(
+        id=AggregationId.random(), title="await", vector_dimension=3,
+        modulus=433, recipient=recipient.agent.id, recipient_key=rkey,
+        masking_scheme=FullMasking(433),
+        committee_sharing_scheme=AdditiveSharing(2, 433),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation_with(
+        agg.id, [c.agent.id for c in clerks[:2]])
+    committee = service.get_committee(recipient.agent, agg.id)
+    members = {str(cid) for cid, _ in committee.clerks_and_keys}
+    for row in ([1, 2, 3], [4, 5, 6]):
+        participant = new_client()
+        participant.participate(row, agg.id)
+    recipient.end_aggregation(agg.id)
+
+    def run_clerks():
+        time.sleep(0.3)
+        for clerk in clerks:
+            if str(clerk.agent.id) in members:
+                clerk.run_chores(-1)
+
+    worker = threading.Thread(target=run_clerks)
+    worker.start()
+    try:
+        output = recipient.await_result(agg.id, deadline=30.0,
+                                        poll_interval=0.05)
+    finally:
+        worker.join()
+    np.testing.assert_array_equal(output.positive().values, [5, 7, 9])
+    assert service.get_round_status(recipient.agent,
+                                    agg.id).state == "revealed"
+
+
+# ---------------------------------------------------------------------------
+# reveal-time quorum robustness (satellite: decrypt_result fix)
+
+@needs_sodium
+def test_reveal_skips_unknown_clerk_result(monkeypatch):
+    """A result from a clerk outside the committee must not abort the
+    reveal from inside the crypto pool: it is skipped with a counted
+    warning and the remaining quorum reconstructs bit-exactly."""
+    service = new_memory_server()
+
+    def new_client():
+        keystore = MemoryKeystore()
+        client = SdaClient(SdaClient.new_agent(keystore), keystore, service)
+        client.upload_agent()
+        return client
+
+    recipient = new_client()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    candidates = {recipient.agent.id: recipient}
+    for _ in range(GOLDEN.share_count):
+        clerk = new_client()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+        candidates[clerk.agent.id] = clerk
+    agg = Aggregation(
+        id=AggregationId.random(), title="tamper", vector_dimension=4,
+        modulus=433, recipient=recipient.agent.id, recipient_key=rkey,
+        masking_scheme=NoMasking(), committee_sharing_scheme=GOLDEN,
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id)
+    committee = service.get_committee(recipient.agent, agg.id)
+    for row in ([1, 2, 3, 4], [2, 3, 4, 5]):
+        new_client().participate(row, agg.id)
+    recipient.end_aggregation(agg.id)
+    for cid, _ in committee.clerks_and_keys:
+        candidates[cid].run_chores(-1)
+
+    original = service.get_snapshot_result
+
+    def tampered(caller, aggregation, snapshot):
+        result = original(caller, aggregation, snapshot)
+        # a stale/hostile result whose clerk is NOT in the committee
+        result.clerk_encryptions.append(ClerkingResult(
+            job=result.clerk_encryptions[0].job, clerk=AgentId.random(),
+            encryption=mock_encryption(b"junk")))
+        return result
+
+    monkeypatch.setattr(service, "get_snapshot_result", tampered)
+    output = recipient.reveal_aggregation(agg.id)
+    np.testing.assert_array_equal(output.positive().values, [3, 5, 7, 9])
+    assert metrics.counter_report()["recipient.result.unknown_clerk"] == 1
+
+
+def test_additive_reconstructor_fails_closed_below_full_set():
+    from sda_tpu.crypto.sharing import AdditiveReconstructor
+
+    recon = AdditiveReconstructor(AdditiveSharing(3, 433))
+    shares = [(i, np.array([i + 1, i + 2], dtype=np.int64)) for i in range(3)]
+    assert recon.reconstruct(shares) is not None
+    with pytest.raises(ValueError, match="need at least 3"):
+        recon.reconstruct(shares[:2])
+
+
+# ---------------------------------------------------------------------------
+# quorum reconstruction coverage (satellite: oracle + JAX lanes)
+
+def test_exact_quorum_matches_full_committee_both_lanes(monkeypatch):
+    """Exactly-``reconstruction_threshold`` survivors reconstruct the
+    same secrets as the full committee — on the host oracle lane AND the
+    JAX device lane — and survivor-set truncation never retraces."""
+    from sda_tpu import fields
+    from sda_tpu.crypto import sharing
+    from sda_tpu.crypto.sharing import (
+        PackedShamirReconstructor,
+        PackedShamirShareGenerator,
+    )
+    from sda_tpu.obs import devprof
+
+    rng = np.random.default_rng(11)
+    secrets = rng.integers(0, GOLDEN.prime_modulus, size=10)
+    shares = PackedShamirShareGenerator(GOLDEN).generate(secrets)
+    r = GOLDEN.reconstruction_threshold
+    survivor_sets = [
+        list(range(GOLDEN.share_count)),          # everyone
+        list(range(r)),                           # exact quorum, prefix
+        [0, 2, 3, 4, 5, 6, 7],                    # exact quorum, with a hole
+    ]
+    for lane_max in (1 << 30, 0):  # host oracle lane, then device lane
+        monkeypatch.setattr(sharing, "HOST_PATH_MAX", lane_max)
+        recon = PackedShamirReconstructor(GOLDEN, dimension=len(secrets))
+        baseline = fields.packed_reconstruct._cache_size()
+        for survivors in survivor_sets:
+            got = recon.reconstruct([(i, shares[i]) for i in survivors])
+            np.testing.assert_array_equal(got, secrets)
+        if lane_max == 0:
+            # fixed-survivor-count truncation: one compiled [r+1, B]
+            # kernel serves every survivor set — zero retraces (the PR 4
+            # devprof tripwire, extended to the quorum path)
+            assert fields.packed_reconstruct._cache_size() == baseline + 1
+            totals = devprof.compile_totals()["functions"]
+            assert totals["fields.packed_reconstruct"]["retraces"] == 0
+        with pytest.raises(ValueError, match="need at least"):
+            recon.reconstruct([(i, shares[i]) for i in range(r - 1)])
+
+
+# ---------------------------------------------------------------------------
+# permanent-death failpoints (satellite: chaos layer)
+
+def test_clerk_dies_failpoint_latches_forever():
+    class NeverPolled:
+        def get_clerking_job(self, caller, clerk):  # pragma: no cover
+            raise AssertionError("a dead clerk must never poll")
+
+    chaos.configure("clerk.dies", kill=True, times=1)
+    client = SdaClient(new_agent(), MemoryKeystore(), NeverPolled())
+    assert client.clerk_once() is False
+    assert client._dead
+    # disarming the failpoint does NOT resurrect the clerk: death is
+    # permanent for the rest of the drill
+    chaos.reset()
+    assert client.clerk_once() is False
+
+
+def test_clerk_dies_times_kills_exactly_k_distinct_clerks():
+    service = new_memory_server()
+    recipient, committee, agg = _mock_world(service, AdditiveSharing(3, 433))
+    service.create_snapshot(
+        recipient, Snapshot(id=SnapshotId.random(), aggregation=agg.id))
+    chaos.configure("clerk.dies", kill=True, times=2)
+    clients = [SdaClient(agent, MemoryKeystore(), service)
+               for (agent, _) in committee[:2]]
+    for client in clients:
+        client.run_chores(-1)  # first run dies; the latch holds after
+        client.run_chores(-1)
+    assert all(c._dead for c in clients)
+    # the budget is spent on exactly K distinct clerks: a third clerk
+    # would NOT be killed
+    assert chaos.evaluate("clerk.dies", kinds=("kill",)) is None
+    # the dead clerks' jobs were never polled, let alone leased
+    jobs = service.server.clerking_job_store.list_snapshot_jobs(
+        service.server.get_round_status(agg.id).snapshot)
+    assert all(not done and leased == 0.0
+               for (_j, _c, done, leased) in jobs)
+
+
+def test_participant_dies_failpoint_skips_contribution():
+    class NeverCalled:
+        def __getattr__(self, name):  # pragma: no cover
+            raise AssertionError(f"dead participant called service.{name}")
+
+    chaos.configure("participant.dies", kill=True, times=1)
+    client = SdaClient(new_agent(), MemoryKeystore(), NeverCalled())
+    assert client.participate([1, 2, 3], AggregationId.random()) is None
+    assert client._dead
+    assert metrics.counter_report()["participant.died"] == 1
+
+
+def test_chaos_spec_parses_kill_kind():
+    chaos.configure_from_spec("clerk.dies=kill,times=2", seed=7)
+    assert chaos.evaluate("clerk.dies", kinds=("kill",)).kind == "kill"
+    assert chaos.evaluate("clerk.dies", kinds=("kill",)).kind == "kill"
+    assert chaos.evaluate("clerk.dies", kinds=("kill",)) is None  # times=2
