@@ -71,6 +71,46 @@ PY
 python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$ROUND_RECORD"
 rm -f "$ROUND_RECORD"
 
+echo "== brownout drill (fixed seed: store browns out mid-clerking; breaker trips, sheds 503+Retry-After, recovers; round bit-exact)"
+BROWNOUT=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --chaos --brownout 1.0 \
+  --chaos-seed 20260803 --chaos-rate 0.05)
+BROWNOUT_RECORD=$(mktemp /tmp/sda-brownout-XXXX.json)
+BROWNOUT="$BROWNOUT" BROWNOUT_RECORD="$BROWNOUT_RECORD" python - <<'PY'
+import json, os
+report = json.loads(os.environ["BROWNOUT"].strip().splitlines()[-1])
+# the round must survive the brownout window bit-exactly: every admitted
+# participation present, reveal exact, despite a second of store failures
+assert report["ready"] and report["exact"], report
+breaker = report["breaker"]
+# the breaker actually did its job: tripped at least once, shed while
+# open, half-opened on probes, and CLOSED again after the window healed
+assert breaker["times_opened"] >= 1, breaker
+assert breaker["state"] == "closed", breaker
+counters = report["counters"]
+assert counters.get("server.store.breaker.shed", 0) >= 1, counters
+assert counters.get("http.status.503", 0) >= 1, counters
+# MTTR: first trip -> final recovery, a hair over the 1 s injected
+# window (the recovery probe cadence is 0.25 s)
+mttr = report["time_to_recover_s"]
+assert mttr and 0 < mttr < 10.0, report
+record = {
+    "metric": "time to recover (store brownout drill, 1s window, breaker threshold 3)",
+    "value": mttr, "unit": "seconds",
+    "platform": "cpu", "seed": report["seed"],
+    "brownout_s": report["brownout_s"],
+    "breaker_recovery_s": breaker["recovery_s"],
+}
+with open(os.environ["BROWNOUT_RECORD"], "w") as f:
+    json.dump(record, f)
+print(f"brownout drill OK: exact={report['exact']}, breaker opened "
+      f"{breaker['times_opened']}x, shed {counters.get('server.store.breaker.shed')} "
+      f"op(s), time_to_recover={mttr}s")
+PY
+# the MTTR record must parse as a bench record and gate (advisory: first
+# record of its metric seeds the trailing window)
+python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$BROWNOUT_RECORD"
+rm -f "$BROWNOUT_RECORD"
+
 echo "== wire codec A/B (fixed seed: same round JSON vs binary, bit-exact both ways)"
 CODEC_JSON=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 16 --dim 64 \
   --load-arrivals closed --load-concurrency 4 --load-seed 20260803 \

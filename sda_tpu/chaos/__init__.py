@@ -20,10 +20,36 @@ point; the call is a near-free no-op until a test or the ``sda-sim
     chaos.configure("http.server.request", delay=0.05, every=3)
     chaos.configure("http.server.response", drop=True, times=1)
 
+Beyond the crisp single-shot kinds (error/delay/drop/kill), three GRAY
+failure kinds model the degradation that dominates production fleets
+("The Tail at Scale", Dean & Barroso, CACM 2013) — a dependency that is
+slow-but-alive, browning out, or reachable from some peers only:
+
+    # elevated latency + elevated error rate for a bounded window
+    chaos.configure("store.poll_clerking_job", brownout=0.02, rate=0.7,
+                    window=5.0, seed=7)
+    # repeating brownout cycles: `window` seconds down, `up` seconds fine
+    chaos.configure("store.poll_clerking_job", flap=0.02, rate=0.7,
+                    window=1.0, up=2.0, seed=7)
+    # scoped connectivity loss: only the process whose chaos identity is
+    # "w0" (chaos.set_identity) sees its store ops fail, healing after 3 s
+    chaos.configure("store.create_clerking_result", partition=True,
+                    node="w0", window=3.0)
+
+Brownout/flap hits inside the down window raise the injected error with
+probability ``rate`` and stall ``delay`` seconds otherwise; outside the
+window they are clean no-ops (and do not consume triggers). A partition
+raises on every in-window hit whose scope matches: ``node=`` matches the
+process-global identity (``set_identity``, set by ``sdad --node-id``),
+``agent=`` matches the caller id the call site passes via
+``evaluate(..., ctx={"agent": ...})``.
+
 Determinism: each failpoint owns a ``random.Random`` seeded from
 ``(seed, name)`` and all trigger decisions are functions of the hit index
 only, taken under one lock — the same hit sequence always produces the
-same injection schedule, so a failing chaos run replays exactly.
+same injection schedule, so a failing chaos run replays exactly. The
+gray kinds keep that discipline for the per-hit error/delay choice; only
+the window boundary itself is wall-clock (anchored at arming time).
 
 Every trigger is counted under ``chaos.<name>`` in ``utils/metrics.py``;
 ``report()`` additionally returns per-point hit/trigger tallies.
@@ -44,6 +70,12 @@ class InjectedFault(ServerError):
     """The default injected error: an ``SdaError`` so the HTTP seam maps it
     to a 500 (a transient server-side failure, exactly what the retrying
     transport must absorb)."""
+
+
+class PartitionedFault(InjectedFault):
+    """A partition-kind injection: the scoped peer cannot reach the seam.
+    Still a ``ServerError`` (HTTP 500 / retried) — a partitioned client
+    cannot tell a dead dependency from an unreachable one."""
 
 
 class Action:
@@ -73,14 +105,27 @@ class Action:
         return f"Action({self.kind!r})"
 
 
+#: What primitive action kinds each gray (composite) kind realizes into.
+_COMPOSITE_KINDS = {
+    "brownout": ("error", "delay"),
+    "flap": ("error", "delay"),
+    "partition": ("error",),
+}
+
+
 class _Failpoint:
     def __init__(self, name: str, *, error=None, delay=None, drop=False,
-                 kill=False, rate: float = 1.0, times: Optional[int] = None,
-                 every: Optional[int] = None, after: int = 0, seed: int = 0):
-        if sum(x is not None and x is not False for x in (error, delay)) \
-                + bool(drop) + bool(kill) != 1:
-            raise ValueError(f"failpoint {name!r}: exactly one of "
-                             "error/delay/drop/kill must be set")
+                 kill=False, brownout=None, flap=None, partition=False,
+                 rate: Optional[float] = None, times: Optional[int] = None,
+                 every: Optional[int] = None, after: int = 0, seed: int = 0,
+                 window: Optional[float] = None, up: Optional[float] = None,
+                 node: Optional[str] = None, agent: Optional[str] = None):
+        if sum(x is not None and x is not False
+               for x in (error, delay, brownout, flap)) \
+                + bool(drop) + bool(kill) + bool(partition) != 1:
+            raise ValueError(f"failpoint {name!r}: exactly one of error/"
+                             "delay/drop/kill/brownout/flap/partition "
+                             "must be set")
         if every is not None and every < 1:
             raise ValueError(f"failpoint {name!r}: every must be >= 1")
         self.name = name
@@ -88,26 +133,81 @@ class _Failpoint:
             self.kind = "kill"
         elif drop:
             self.kind = "drop"
+        elif partition:
+            self.kind = "partition"
+        elif flap is not None:
+            self.kind = "flap"
+        elif brownout is not None:
+            self.kind = "brownout"
         elif delay is not None:
             self.kind = "delay"
         else:
             self.kind = "error"
         # error=True means "use the default injected fault"
         self.exc_factory = (
-            (lambda: InjectedFault(f"chaos: injected failure at {name}"))
-            if error is True or error is None
-            else (error if callable(error) else (lambda: error))
+            (error if callable(error) else (lambda: error))
+            if error is not None and error is not True
+            else (lambda: PartitionedFault(
+                f"chaos: partitioned at {name}"))
+            if self.kind == "partition"
+            else (lambda: InjectedFault(f"chaos: injected failure at {name}"))
         )
-        self.delay_s = float(delay or 0.0)
+        self.delay_s = float(delay or brownout or flap or 0.0)
+        # gray-kind rate is the ERROR fraction inside the down window (the
+        # rest of the hits stall instead); default 0.5 keeps both symptoms
+        # visible. Classic kinds keep the historical always-trigger default.
+        if rate is None:
+            rate = 0.5 if self.kind in ("brownout", "flap") else 1.0
         self.rate = float(rate)
         self.times = times
         self.every = every
         self.after = int(after)
+        if self.kind == "flap" and (not window or up is None):
+            raise ValueError(f"failpoint {name!r}: flap needs window= "
+                             "(down seconds) and up= (healthy seconds)")
+        if self.kind == "brownout" and not window:
+            raise ValueError(f"failpoint {name!r}: brownout needs window= "
+                             "(down seconds)")
+        self.window_s = None if window is None else float(window)
+        self.up_s = None if up is None else float(up)
+        #: partition scope: restrict triggering to the process whose chaos
+        #: identity is ``node`` and/or to call sites whose ctx carries
+        #: ``agent`` — None matches everything
+        self.node = node
+        self.agent = agent
+        #: window anchor: gray kinds degrade from the moment they are armed
+        self.armed_at = time.time()
         # per-point RNG keyed on (seed, name): schedules are independent
         # across failpoints and reproducible for a given hit order
         self.rng = random.Random(f"{seed}:{name}")
         self.hits = 0
         self.triggers = 0
+
+    def expressible(self, kinds) -> bool:
+        """Whether a call site restricted to ``kinds`` can perform this
+        point's action at all (composite kinds need every primitive they
+        may realize into, so the seeded schedule stays site-independent)."""
+        if kinds is None:
+            return True
+        needed = _COMPOSITE_KINDS.get(self.kind, (self.kind,))
+        return all(k in kinds for k in needed)
+
+    def _in_window(self, now: float) -> bool:
+        """Whether a gray kind is currently in its DOWN phase."""
+        elapsed = now - self.armed_at
+        if self.kind == "flap":
+            return elapsed % (self.window_s + self.up_s) < self.window_s
+        if self.window_s is None:
+            return True  # unbounded (partition without window=): heals
+            # only on clear()
+        return elapsed < self.window_s
+
+    def _scope_matches(self, ctx, identity) -> bool:
+        if self.node is not None and self.node != identity:
+            return False
+        if self.agent is not None:
+            return str((ctx or {}).get("agent")) == self.agent
+        return True
 
     def should_trigger(self) -> bool:
         """Decide for the current hit; caller holds the registry lock."""
@@ -125,11 +225,45 @@ class _Failpoint:
         return True
 
     def action(self) -> Action:
-        if self.kind == "error":
+        if self.kind in ("error", "partition"):
             return Action("error", exc=self.exc_factory())
         if self.kind == "delay":
             return Action("delay", delay_s=self.delay_s)
         return Action(self.kind)  # "drop" or "kill": no payload
+
+    def realize(self, now: float, ctx, identity) -> Optional[Action]:
+        """The full per-hit decision (caller holds the registry lock):
+        classic kinds keep the historic should_trigger/action split; gray
+        kinds additionally gate on the window and scope — an out-of-window
+        or out-of-scope hit is a clean no-op that consumes NOTHING, so the
+        seeded schedule describes only the degraded phase."""
+        if self.kind in ("brownout", "flap"):
+            if not self._in_window(now):
+                return None
+            hit = self.hits
+            self.hits += 1
+            if hit < self.after:
+                return None
+            if self.times is not None and self.triggers >= self.times:
+                return None
+            if self.every is not None and (hit - self.after) % self.every:
+                return None
+            self.triggers += 1
+            # seeded per-hit split: error with probability `rate`, stall
+            # otherwise — both symptoms of one browning-out dependency
+            if self.rng.random() < self.rate:
+                return Action("error", exc=self.exc_factory())
+            return Action("delay", delay_s=self.delay_s)
+        if self.kind == "partition":
+            if not self._scope_matches(ctx, identity) \
+                    or not self._in_window(now):
+                return None
+            if not self.should_trigger():
+                return None
+            return Action("error", exc=self.exc_factory())
+        if not self.should_trigger():
+            return None
+        return self.action()
 
 
 class FailpointRegistry:
@@ -140,6 +274,15 @@ class FailpointRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._points: Dict[str, _Failpoint] = {}
+        #: process identity for partition scoping (``sdad --node-id``)
+        self._identity: Optional[str] = None
+
+    def set_identity(self, node_id: Optional[str]) -> None:
+        """Name this process for ``partition`` scoping: a spec with
+        ``node=w0`` triggers only in the process whose identity is w0 —
+        how one fleet-wide spec partitions exactly one worker from the
+        shared store."""
+        self._identity = node_id
 
     def configure(self, name: str, **kwargs) -> None:
         """(Re)arm a failpoint; see module docstring for the knobs."""
@@ -158,7 +301,7 @@ class FailpointRegistry:
     def active(self) -> bool:
         return bool(self._points)
 
-    def evaluate(self, name: str, kinds=None) -> Optional[Action]:
+    def evaluate(self, name: str, kinds=None, ctx=None) -> Optional[Action]:
         """Return the action if ``name`` is armed and triggers this hit,
         else None. Counts ``chaos.<name>`` on trigger. The un-armed path
         is one dict lookup — cheap enough for hot paths.
@@ -167,17 +310,21 @@ class FailpointRegistry:
         (e.g. the clerk loop only understands ``drop``); an armed
         failpoint of another kind is ignored WITHOUT consuming a hit or
         trigger, so the schedule and counters never claim an injection
-        that could not happen."""
+        that could not happen. ``ctx`` carries call-site scope facts
+        (currently ``{"agent": id}``) that ``partition`` specs match."""
         point = self._points.get(name)
         if point is None:
             return None
-        if kinds is not None and point.kind not in kinds:
+        if not point.expressible(kinds):
             return None
+        now = time.time()
         with self._lock:
             # re-check: a concurrent clear() may have raced the lookup
-            if self._points.get(name) is not point or not point.should_trigger():
+            if self._points.get(name) is not point:
                 return None
-            action = point.action()
+            action = point.realize(now, ctx, self._identity)
+            if action is None:
+                return None
         metrics.count(f"chaos.{name}")
         # stamp the injection on the active span (no-op without one): a
         # trace timeline then shows WHICH injected fault hit WHICH round
@@ -215,26 +362,48 @@ clear = registry.clear
 evaluate = registry.evaluate
 fail = registry.fail
 report = registry.report
+set_identity = registry.set_identity
 
 
 def reset() -> None:
-    """Disarm everything — test-teardown hygiene."""
+    """Disarm everything — test-teardown hygiene (the identity is config,
+    not schedule state: it survives)."""
     registry.clear()
 
 
-def configure_from_spec(spec: str, seed: int = 0) -> None:
-    """Arm failpoints from a compact string (CLI / env friendly):
+#: spec keys -> coercion; None means "keep the string"
+_SPEC_KEYS = {
+    "rate": float, "times": int, "every": int, "after": int,
+    "for": float, "up": float, "node": None, "agent": None,
+}
+
+
+def parse_spec(spec: str, seed: int = 0) -> Dict[str, dict]:
+    """Parse a compact failpoint spec into ``{name: configure-kwargs}``
+    WITHOUT arming anything (CLI / env friendly):
 
         "http.server.request=error,rate=0.15;clerk.dies=kill,times=1"
+        "store.poll_clerking_job,store.create_clerking_result=\
+brownout:0.02,rate=0.7,for=5"
+        "store.create_participation=partition,node=w0,for=3"
 
-    Each ``;``-separated entry is ``name=kind[,key=value...]`` with kind in
-    error|delay:SECONDS|drop|kill and keys rate/times/every/after.
-    """
+    Each ``;``-separated entry is ``names=kind[,key=value...]`` where
+    ``names`` may be several comma-separated failpoint names sharing one
+    action (the ``,`` before the first ``=`` separates targets; after it,
+    keys). Kinds: error | delay:SECONDS | drop | kill | brownout:SECONDS |
+    flap:SECONDS | partition. Keys: rate/times/every/after plus the
+    gray-kind window ``for=SECONDS``, flap's healthy phase ``up=SECONDS``,
+    and partition scope ``node=``/``agent=``.
+
+    Naming the same failpoint twice IN ONE parse is a conflict and raises
+    — two actions cannot share one choke point; ``configure_from_specs``
+    extends that check across multiple ``--chaos-spec`` flags."""
+    out: Dict[str, dict] = {}
     for entry in spec.split(";"):
         entry = entry.strip()
         if not entry:
             continue
-        name, _, rest = entry.partition("=")
+        names, _, rest = entry.partition("=")
         if not rest:
             raise ValueError(f"chaos spec entry {entry!r}: expected name=kind[,...]")
         parts = rest.split(",")
@@ -246,13 +415,61 @@ def configure_from_spec(spec: str, seed: int = 0) -> None:
             kwargs["drop"] = True
         elif kind == "kill":
             kwargs["kill"] = True
+        elif kind == "partition":
+            kwargs["partition"] = True
         elif kind.startswith("delay:"):
             kwargs["delay"] = float(kind.split(":", 1)[1])
+        elif kind.startswith("brownout:"):
+            kwargs["brownout"] = float(kind.split(":", 1)[1])
+        elif kind.startswith("flap:"):
+            kwargs["flap"] = float(kind.split(":", 1)[1])
         else:
             raise ValueError(f"chaos spec entry {entry!r}: unknown kind {kind!r}")
         for part in parts[1:]:
             key, _, value = part.strip().partition("=")
-            if key not in ("rate", "times", "every", "after"):
+            coerce = _SPEC_KEYS.get(key, ...)
+            if coerce is ...:
                 raise ValueError(f"chaos spec entry {entry!r}: unknown key {key!r}")
-            kwargs[key] = float(value) if key == "rate" else int(value)
-        configure(name.strip(), **kwargs)
+            # "for" is the spec spelling of the window (python keyword)
+            kwargs["window" if key == "for" else key] = (
+                value if coerce is None else coerce(value))
+        for name in names.split(","):
+            name = name.strip()
+            if not name:
+                raise ValueError(f"chaos spec entry {entry!r}: empty "
+                                 "failpoint name")
+            if name in out:
+                raise ValueError(
+                    f"chaos spec conflict: failpoint {name!r} armed twice "
+                    f"(second action {kind!r}) — one choke point takes "
+                    "exactly one action; merge or drop one entry")
+            out[name] = kwargs
+    return out
+
+
+def configure_from_spec(spec: str, seed: int = 0) -> None:
+    """Parse ``spec`` (see :func:`parse_spec`) and arm every entry."""
+    for name, kwargs in parse_spec(spec, seed=seed).items():
+        configure(name, **kwargs)
+
+
+def configure_from_specs(specs, seed: int = 0) -> None:
+    """Arm several spec strings (repeated ``--chaos-spec`` flags) as one
+    composed drill — brownout + kill + partition in one invocation —
+    rejecting any failpoint named by more than one spec with a clear
+    error that says WHICH flag collided."""
+    seen: Dict[str, int] = {}
+    parsed = []
+    for ix, spec in enumerate(specs):
+        entries = parse_spec(spec, seed=seed)
+        for name in entries:
+            if name in seen:
+                raise ValueError(
+                    f"chaos spec conflict: failpoint {name!r} is armed by "
+                    f"--chaos-spec #{seen[name] + 1} and #{ix + 1}; one "
+                    "choke point takes exactly one action")
+            seen[name] = ix
+        parsed.append(entries)
+    for entries in parsed:
+        for name, kwargs in entries.items():
+            configure(name, **kwargs)

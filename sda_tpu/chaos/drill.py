@@ -42,12 +42,13 @@ def run_chaos_drill(
     timeout_s: float = 60.0,
     store: str = "memory",
     store_path=None,
-    extra_spec: str = None,
+    extra_spec=None,
     dead_clerks: int = 0,
     dead_participants: int = 0,
     sharing: str = "packed",
     clerking_deadline_s: float = 1.5,
     sweep_interval_s: float = 0.2,
+    brownout_s: float = 0.0,
 ) -> dict:
     """Run one full aggregation round over HTTP under injected faults.
 
@@ -62,6 +63,19 @@ def run_chaos_drill(
     additive sharing (``sharing="additive"``) reaches ``failed`` with a
     machine-readable reason, surfaced through the typed
     ``RoundFailed`` raised by ``SdaClient.await_result``.
+
+    ``brownout_s`` arms the GRAY-failure recovery drill: mid-clerking,
+    the store's job-poll and result-write paths brown out (seeded
+    elevated error rate + latency, ``chaos brownout`` kind) for that many
+    seconds, behind a store circuit breaker (``server/breaker.py``) that
+    must trip OPEN — shedding 503 + Retry-After instead of queueing —
+    half-open on probes, and CLOSE once the window heals. The round must
+    still reveal bit-exactly, and the report's ``breaker`` block records
+    ``time_to_recover_s`` (MTTR: first trip -> final recovery), the
+    fixed-seed record ci.sh feeds the bench regression gate.
+
+    ``extra_spec`` is one spec string or a list of them (the repeatable
+    ``--chaos-spec`` flag), merged with conflict rejection.
 
     Returns the report dict (``exact``, ``injected_ratio``, the round's
     lifecycle history, counters...). Requires libsodium (real sealed-box
@@ -79,6 +93,7 @@ def run_chaos_drill(
         FullMasking,
         PackedShamirSharing,
         RoundFailed,
+        ServerError,
         SodiumEncryption,
     )
     from ..server import new_jsonfs_server, new_memory_server, new_sqlite_server
@@ -121,6 +136,17 @@ def run_chaos_drill(
         raise ValueError(f"unknown store {store!r}")
     service_impl.server.clerking_lease_seconds = lease_seconds
 
+    breaker = None
+    if brownout_s:
+        # the brownout-survival plane under test: a shared breaker over
+        # the whole backend, tuned to trip within a handful of failed
+        # store ops and probe on a sub-second cadence (the drill's
+        # brownout windows are short)
+        from ..server.breaker import CircuitBreaker, wrap_server_stores
+
+        breaker = wrap_server_stores(service_impl.server, CircuitBreaker(
+            threshold=3, recovery_s=0.25, budget_rate=4.0))
+
     sweeper = None
     if dead_clerks:
         # the supervisor plane: a clerking deadline so dead-clerk
@@ -146,8 +172,13 @@ def run_chaos_drill(
                     token="chaos-drill-token",
                     # fast, deterministic-budget retries: the drill injects a
                     # bounded failure schedule, so a handful of quick attempts
-                    # always clears it
-                    max_retries=8, backoff_base=0.01, backoff_cap=0.1,
+                    # always clears it. A brownout window is a SUSTAINED
+                    # outage, so that mode gets the budget to ride it out
+                    # (Retry-After hints from the open breaker pace the
+                    # attempts)
+                    max_retries=24 if brownout_s else 8,
+                    backoff_base=0.01,
+                    backoff_cap=0.25 if brownout_s else 0.1,
                 )
                 agent = SdaClient.new_agent(keystore)
                 return SdaClient(agent, keystore, proxy)
@@ -203,7 +234,9 @@ def run_chaos_drill(
                 chaos.configure("participant.dies", kill=True,
                                 times=dead_participants, seed=seed)
             if extra_spec:
-                chaos.configure_from_spec(extra_spec, seed=seed)
+                specs = ([extra_spec] if isinstance(extra_spec, str)
+                         else list(extra_spec))
+                chaos.configure_from_specs(specs, seed=seed)
 
             rng = np.random.default_rng(seed)
             inputs = rng.integers(0, modulus,
@@ -218,6 +251,19 @@ def run_chaos_drill(
                 if not participant._dead:
                     alive_rows.append(row)
             recipient.end_aggregation(agg.id)  # snapshot + job fan-out
+
+            brownout_started = None
+            if brownout_s:
+                # the store browns out MID-CLERKING: fan-out is durable,
+                # the committee is about to hammer the job-poll and
+                # result-write paths — elevated error rate + latency for
+                # the seeded window, breaker in front
+                brownout_started = time.monotonic()
+                chaos.configure("store.poll_clerking_job", brownout=0.01,
+                                rate=0.85, window=brownout_s, seed=seed)
+                chaos.configure("store.create_clerking_result",
+                                brownout=0.01, rate=0.85,
+                                window=brownout_s, seed=seed)
 
             def round_state():
                 try:
@@ -239,10 +285,20 @@ def run_chaos_drill(
             final_round = None
             while time.monotonic() < deadline:
                 for clerk in clerks:
-                    clerk.run_chores(-1)
-                status = recipient.service.get_aggregation_status(
-                    recipient.agent, agg.id
-                )
+                    try:
+                        clerk.run_chores(-1)
+                    except ServerError:
+                        # a brownout window can outlast even the padded
+                        # transport retry budget: the clerk is fine, the
+                        # dependency is not — come back next pass
+                        metrics.count("clerk.chores.transient")
+                try:
+                    status = recipient.service.get_aggregation_status(
+                        recipient.agent, agg.id
+                    )
+                except ServerError:
+                    metrics.count("recipient.status.transient")
+                    status = None
                 results = (status.snapshots[0].number_of_clerking_results
                            if status is not None and status.snapshots else 0)
                 if not dead_clerks and results >= scheme.share_count:
@@ -317,6 +373,7 @@ def run_chaos_drill(
 
     round_history = (final_round.history
                      if dead_clerks and final_round is not None else None)
+    breaker_report = breaker.report() if breaker is not None else None
     report = {
         "mode": f"chaos drill over HTTP ({store} store)",
         "participants": participants,
@@ -344,6 +401,12 @@ def run_chaos_drill(
         "time_to_degraded_s": _phase_gap(round_history, "clerking",
                                          "degraded"),
         "time_to_failed_s": _phase_gap(round_history, "clerking", "failed"),
+        # brownout-recovery verdict (server/breaker.py): how long the
+        # store was effectively down from the breaker's point of view —
+        # first trip to final recovery, the MTTR headline ci.sh records
+        "brownout_s": brownout_s or None,
+        "breaker": breaker_report,
+        "time_to_recover_s": (breaker_report or {}).get("time_to_recover_s"),
         "failure": failure,
         "injected_faults": injected,
         "failed_requests": failed_requests,
@@ -352,7 +415,8 @@ def run_chaos_drill(
         "counters": {
             k: v for k, v in counters.items()
             if k.startswith(("chaos.", "http.retry.", "http.status.",
-                             "server.job.", "server.snapshot."))
+                             "server.job.", "server.snapshot.",
+                             "server.store.breaker.", "server.fleet."))
         },
         # per-route server latency under fire: the tail the retry budget
         # has to ride out (loadgen measures the same table under load)

@@ -55,6 +55,35 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--drain-grace", type=float, metavar="SECONDS",
                         default=10.0,
                         help="per-worker in-flight grace on shutdown")
+    parser.add_argument("--heartbeat", type=float, metavar="SECONDS",
+                        default=1.0,
+                        help="fleet health: every worker writes its "
+                             "heartbeat row to the shared store this "
+                             "often (0 disables the health plane)")
+    parser.add_argument("--dead-after", type=float, metavar="SECONDS",
+                        default=None,
+                        help="declare a worker DEAD (and recall its held "
+                             "clerking-job leases) after SECONDS without "
+                             "a heartbeat; default 4x the heartbeat "
+                             "interval")
+    parser.add_argument("--suspect-after", type=float, metavar="SECONDS",
+                        default=None,
+                        help="declare a worker SUSPECT after SECONDS "
+                             "without a heartbeat; default half of "
+                             "--dead-after")
+    parser.add_argument("--round-sweep", type=float, metavar="SECONDS",
+                        default=1.0,
+                        help="per-worker sweeper cadence (runs the round "
+                             "lifecycle supervisor AND the fleet failure "
+                             "detector; 0 disables)")
+    parser.add_argument("--hedge", action="store_true",
+                        help="straggler hedging: peers speculatively "
+                             "re-execute jobs held by SUSPECT workers "
+                             "(single-winner commit keeps it bit-exact)")
+    parser.add_argument("--store-breaker", action="store_true",
+                        help="per-worker store circuit breaker: shed "
+                             "503 + Retry-After fast while the shared "
+                             "backend browns out")
     parser.add_argument("--metrics", action="store_true",
                         help="serve /metrics on every worker (samples carry "
                              "the worker's node_id label)")
@@ -74,6 +103,19 @@ def worker_extra_args(args) -> list:
     extra = ["--drain-grace", str(args.drain_grace)]
     if args.job_lease:
         extra += ["--job-lease", str(args.job_lease)]
+    if args.heartbeat:
+        dead_after = (args.dead_after if args.dead_after is not None
+                      else 4 * args.heartbeat)
+        extra += ["--heartbeat", str(args.heartbeat),
+                  "--dead-after", str(dead_after)]
+        if args.suspect_after is not None:
+            extra += ["--suspect-after", str(args.suspect_after)]
+        if args.hedge:
+            extra.append("--hedge")
+    if args.round_sweep:
+        extra += ["--round-sweep", str(args.round_sweep)]
+    if args.store_breaker:
+        extra.append("--store-breaker")
     if args.metrics:
         extra.append("--metrics")
     if args.statusz:
@@ -148,8 +190,26 @@ def main(argv=None) -> int:
         stop.wait()
     except KeyboardInterrupt:
         pass
+    # final health snapshot BEFORE the drain: which workers the fleet
+    # believed alive/suspect/dead at shutdown (scraped off any live
+    # worker — the table lives in the SHARED store)
+    health_table = None
+    if args.statusz and args.heartbeat:
+        import requests
+
+        for address in fleet.addresses.values():
+            try:
+                health_table = requests.get(
+                    address + "/statusz", timeout=5.0
+                ).json().get("fleet_health")
+                break
+            except Exception:
+                continue
     summaries = fleet.stop()
-    print(json.dumps({"drained": summaries}), flush=True)
+    out = {"drained": summaries}
+    if health_table is not None:
+        out["fleet_health"] = health_table
+    print(json.dumps(out), flush=True)
     leaked = sum(int(s.get("leaked", 0) or 0) for s in summaries)
     killed = any(s.get("killed") for s in summaries)
     died = any((w.returncode or 0) != 0 for w in fleet.workers)

@@ -90,11 +90,62 @@ def build_parser() -> argparse.ArgumentParser:
                              "degrade to the surviving quorum, additive "
                              "rounds fail with a diagnosis (needs "
                              "--round-sweep)")
-    parser.add_argument("--chaos-spec", type=str, default=None,
+    parser.add_argument("--heartbeat", type=float, metavar="SECONDS",
+                        default=None,
+                        help="fleet health: write this worker's heartbeat "
+                             "row to the shared store every SECONDS "
+                             "(needs --node-id; the failure detector and "
+                             "straggler hedging read the table — "
+                             "docs/robustness.md gray-failure matrix)")
+    parser.add_argument("--suspect-after", type=float, metavar="SECONDS",
+                        default=None,
+                        help="fleet health: a peer whose heartbeat is "
+                             "staler than SECONDS is declared SUSPECT "
+                             "(single-winner CAS; hedging may shadow its "
+                             "held jobs). Default: half of --dead-after")
+    parser.add_argument("--dead-after", type=float, metavar="SECONDS",
+                        default=None,
+                        help="fleet health: a peer whose heartbeat is "
+                             "staler than SECONDS is declared DEAD and "
+                             "its held clerking-job leases are recalled "
+                             "so any worker's next poll reissues them "
+                             "immediately (needs --round-sweep to run "
+                             "the detector)")
+    parser.add_argument("--hedge", action="store_true",
+                        help="straggler hedging: an empty job poll may "
+                             "speculatively re-lease a job held by a "
+                             "SUSPECT peer; result commit stays "
+                             "single-winner, so duplicate partial sums "
+                             "are impossible (needs --heartbeat config)")
+    parser.add_argument("--store-breaker", action="store_true",
+                        help="wrap the store backend in a circuit "
+                             "breaker + retry budget: a browning-out "
+                             "store trips OPEN and requests shed fast "
+                             "with 503 + Retry-After instead of queueing "
+                             "behind a slow dependency; probes half-open "
+                             "it back (docs/robustness.md)")
+    parser.add_argument("--breaker-threshold", type=int, metavar="N",
+                        default=5,
+                        help="consecutive store failures that trip the "
+                             "breaker (--store-breaker)")
+    parser.add_argument("--breaker-recovery", type=float, metavar="SECONDS",
+                        default=1.0,
+                        help="open-state hold before a half-open probe "
+                             "(--store-breaker)")
+    parser.add_argument("--breaker-budget", type=float, metavar="RPS",
+                        default=2.0,
+                        help="shared store-retry budget refill rate, "
+                             "tokens/sec (--store-breaker)")
+    parser.add_argument("--chaos-spec", action="append", default=None,
+                        metavar="SPEC",
                         help="arm failpoints in THIS worker process, e.g. "
-                             "'http.server.request=error,rate=0.05' (the "
-                             "fleet drill's per-worker fault injection; "
-                             "see sda_tpu.chaos.configure_from_spec)")
+                             "'http.server.request=error,rate=0.05' or "
+                             "'store.poll_clerking_job=brownout:0.02,"
+                             "rate=0.7,for=5'. Repeatable — brownout + "
+                             "kill + partition drills compose in one "
+                             "invocation; arming one failpoint from two "
+                             "specs is rejected with a clear error (see "
+                             "sda_tpu.chaos.configure_from_specs)")
     parser.add_argument("--chaos-seed", type=int, default=0,
                         help="failpoint schedule seed (--chaos-spec)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
@@ -130,6 +181,25 @@ def main(argv=None) -> int:
         service.server.premix_paillier = True
     if args.job_lease is not None:
         service.server.clerking_lease_seconds = args.job_lease
+    if args.store_breaker:
+        # wrap BEFORE anything touches the stores so every code path —
+        # HTTP handlers, sweeper, heartbeat writer — rides the breaker
+        from ..server.breaker import CircuitBreaker, wrap_server_stores
+
+        wrap_server_stores(service.server, CircuitBreaker(
+            threshold=args.breaker_threshold,
+            recovery_s=args.breaker_recovery,
+            budget_rate=args.breaker_budget,
+        ))
+    suspect_after = args.suspect_after
+    if suspect_after is None and args.dead_after is not None:
+        suspect_after = args.dead_after / 2
+    if args.hedge:
+        if suspect_after is None:
+            parser_error = "--hedge needs --suspect-after or --dead-after"
+            print(f"error: {parser_error}", file=sys.stderr)
+            return 2
+        service.server.hedge_suspect_after_s = suspect_after
     sweeper = None
     if args.round_collect_deadline is not None \
             or args.round_clerk_deadline is not None:
@@ -143,11 +213,25 @@ def main(argv=None) -> int:
         from ..server import lifecycle
 
         sweeper = lifecycle.RoundSweeper(
-            service.server, interval_s=args.round_sweep).start()
+            service.server, interval_s=args.round_sweep,
+            heartbeat_suspect_s=suspect_after,
+            heartbeat_dead_s=args.dead_after).start()
+    heartbeat = None
+    if args.heartbeat is not None:
+        if not args.node_id:
+            print("error: --heartbeat needs --node-id (the heartbeat row "
+                  "is keyed by worker identity)", file=sys.stderr)
+            return 2
+        from ..server.health import HeartbeatWriter
+
+        heartbeat = HeartbeatWriter(
+            service.server.clerking_job_store, args.node_id,
+            interval_s=args.heartbeat).start()
     if args.chaos_spec:
         from .. import chaos
 
-        chaos.configure_from_spec(args.chaos_spec, seed=args.chaos_seed)
+        chaos.set_identity(args.node_id)
+        chaos.configure_from_specs(args.chaos_spec, seed=args.chaos_seed)
 
     server = SdaHttpServer(
         service, bind=args.bind,
@@ -195,7 +279,17 @@ def main(argv=None) -> int:
         # stop sweeping BEFORE the drain releases leases: a sweep racing
         # the lease handback could read a transiently unleased job as dead
         sweeper.stop()
+    if heartbeat is not None:
+        # stop BEATING now, but the terminal 'drained' row only lands
+        # AFTER the drain below hands the held leases back: a worker
+        # killed mid-drain must look stale-alive (diagnosable -> leases
+        # recalled), never prematurely 'drained' (terminal, skipped by
+        # the failure detector) while it still holds work
+        heartbeat.stop(drained=False)
     summary = server.drain(grace_s=args.drain_grace)
+    if heartbeat is not None:
+        # leases are handed back: NOW peers never need to diagnose us
+        heartbeat.stop(drained=True)
     print(f"sdad drained {json.dumps(summary)}", flush=True)
     return 0 if summary["leaked"] == 0 else 1
 

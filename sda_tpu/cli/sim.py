@@ -120,10 +120,26 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--chaos-store", choices=["memory", "sqlite", "jsonfs"],
                         default="memory",
                         help="server store backend for --chaos")
-    parser.add_argument("--chaos-spec", type=str, default=None,
+    parser.add_argument("--chaos-spec", action="append", default=None,
+                        metavar="SPEC",
                         help="extra failpoints, e.g. "
-                             "'store.poll_clerking_job=error,times=2' "
-                             "(see sda_tpu.chaos.configure_from_spec)")
+                             "'store.poll_clerking_job=error,times=2' or "
+                             "'store.poll_clerking_job,store."
+                             "create_clerking_result=brownout:0.02,"
+                             "rate=0.7,for=2'. Repeatable — brownout + "
+                             "kill drills compose; arming one failpoint "
+                             "twice is rejected with a clear error (see "
+                             "sda_tpu.chaos.configure_from_specs)")
+    parser.add_argument("--brownout", type=float, metavar="SECONDS",
+                        default=0.0,
+                        help="store-brownout recovery drill (--chaos): "
+                             "mid-clerking, the store backend browns out "
+                             "for SECONDS (elevated error rate + latency "
+                             "on every job poll/result write) behind a "
+                             "circuit breaker; the round must still "
+                             "reveal bit-exactly and the report records "
+                             "the breaker's time_to_recover_s MTTR "
+                             "(docs/robustness.md)")
     parser.add_argument("--dead-clerks", type=int, metavar="K", default=0,
                         help="permanently kill K clerks (clerk.dies kill "
                              "failpoint) and arm the round lifecycle "
@@ -364,9 +380,18 @@ def _run_chaos(args) -> int:
             extra_spec=args.chaos_spec,
             dead_clerks=args.dead_clerks,
             sharing=args.chaos_sharing,
+            brownout_s=args.brownout,
         )
     _export_trace(args, report)
     print(json.dumps(report))
+    # brownout recovery rides AND with whichever round verdict applies
+    # below (a composed --brownout --dead-clerks drill must satisfy both):
+    # the breaker tripped at least once and recovered
+    brownout_ok = True
+    if args.brownout:
+        breaker = report.get("breaker") or {}
+        brownout_ok = (breaker.get("times_opened", 0) > 0
+                       and breaker.get("time_to_recover_s") is not None)
     if args.dead_clerks and args.chaos_sharing == "additive":
         # additive cannot survive a dead clerk: success is a DETERMINISTIC
         # terminal 'failed' with a machine-readable reason (no hang)
@@ -380,7 +405,7 @@ def _run_chaos(args) -> int:
               and report.get("round_state") in ("degraded", "revealed"))
     else:
         ok = bool(report["exact"])
-    return 0 if ok else 1
+    return 0 if ok and brownout_ok else 1
 
 
 def main(argv=None) -> int:

@@ -38,6 +38,7 @@ from ..protocol import (
     RoundExpired,
     RoundFailed,
     SdaService,
+    ServerError,
     Snapshot,
     SnapshotId,
 )
@@ -636,48 +637,96 @@ class SdaClient:
         ``deadline`` bounds the wait in seconds client-side (``None`` =
         wait for a server verdict indefinitely); exceeding it raises
         ``RoundExpired`` too, tagged as the client's deadline.
+
+        Herd hygiene: each iteration sleeps ``poll_interval`` scaled by a
+        jitter factor in [0.5, 1.5) drawn from an RNG seeded on (agent,
+        aggregation) — thousands of recipients waiting on one round
+        decorrelate deterministically instead of stampeding a recovering
+        server in lockstep. Transient server trouble during a poll (a
+        browning-out store shedding 503s, ``StoreUnavailable`` in
+        process) does not abort the wait: the loop backs off — honoring
+        the server's ``Retry-After`` hint when the error carries one —
+        and keeps polling until the deadline.
         """
+        import random as _random
+
         give_up = (None if deadline is None
                    else time.monotonic() + float(deadline))
+        # seeded per-(agent, aggregation): deterministic for drills,
+        # distinct across the recipient population
+        jitter_rng = _random.Random(f"{self.agent.id}:{aggregation_id}")
         round_status = None
+        last_transient = None
+        transient_streak = 0
         with obs.span("recipient.await_result",
                       attributes={"aggregation": str(aggregation_id)}):
             while True:
-                round_status = self.service.get_round_status(
-                    self.agent, aggregation_id)
-                if round_status is not None and round_status.state in (
-                        "failed", "expired"):
-                    exc = (RoundExpired if round_status.state == "expired"
-                           else RoundFailed)
-                    raise exc(
-                        f"round {aggregation_id} is {round_status.state}: "
-                        f"{round_status.reason or 'no reason recorded'}",
-                        state=round_status.state,
-                        reason=round_status.reason,
-                        dead_clerks=round_status.dead_clerks,
-                    )
-                status = self.service.get_aggregation_status(
-                    self.agent, aggregation_id)
-                if status is not None:
-                    if snapshot_id is not None:
-                        snap = next((s for s in status.snapshots
-                                     if s.id == snapshot_id), None)
-                    else:
-                        snap = next((s for s in status.snapshots
-                                     if s.result_ready), None)
-                    if snap is not None and snap.result_ready:
-                        return self.reveal_aggregation(aggregation_id, snap.id)
+                retry_after = None
+                try:
+                    round_status = self.service.get_round_status(
+                        self.agent, aggregation_id)
+                    if round_status is not None and round_status.state in (
+                            "failed", "expired"):
+                        exc = (RoundExpired if round_status.state == "expired"
+                               else RoundFailed)
+                        raise exc(
+                            f"round {aggregation_id} is {round_status.state}: "
+                            f"{round_status.reason or 'no reason recorded'}",
+                            state=round_status.state,
+                            reason=round_status.reason,
+                            dead_clerks=round_status.dead_clerks,
+                        )
+                    status = self.service.get_aggregation_status(
+                        self.agent, aggregation_id)
+                    if status is not None:
+                        if snapshot_id is not None:
+                            snap = next((s for s in status.snapshots
+                                         if s.id == snapshot_id), None)
+                        else:
+                            snap = next((s for s in status.snapshots
+                                         if s.result_ready), None)
+                        if snap is not None and snap.result_ready:
+                            return self.reveal_aggregation(aggregation_id,
+                                                           snap.id)
+                    transient_streak = 0  # a poll got through
+                except ServerError as e:
+                    # transient server trouble (injected 500s past the
+                    # transport's retry budget, breaker-open 503 sheds):
+                    # the round may well be fine — keep waiting, on the
+                    # server's schedule when it gave one. With NO client
+                    # deadline, a long unbroken failure streak is a dead
+                    # server, not a brownout: propagate rather than spin
+                    # forever (each streak element already survived the
+                    # transport's full retry budget)
+                    last_transient = e
+                    transient_streak += 1
+                    if give_up is None and transient_streak >= 8:
+                        raise
+                    retry_after = getattr(e, "retry_after", None)
+                    metrics.count("recipient.await.transient")
+                    log.debug("await_result poll failed transiently "
+                              "(%s); backing off", e)
                 if give_up is not None and time.monotonic() >= give_up:
                     raise RoundExpired(
                         f"await_result deadline exceeded client-side for "
                         f"{aggregation_id}" + (
                             f" (server round state: {round_status.state})"
-                            if round_status is not None else ""),
+                            if round_status is not None else "") + (
+                            f" (last transient poll error: {last_transient})"
+                            if last_transient is not None
+                            and round_status is None else ""),
                         state=(round_status.state
                                if round_status is not None else None),
                         reason="client await_result deadline exceeded",
                     )
-                time.sleep(poll_interval)
+                # Retry-After beats the cadence; both get the seeded
+                # jitter factor so recovering servers see a spread-out
+                # herd, not a synchronized one
+                base = retry_after if retry_after else poll_interval
+                sleep = base * (0.5 + jitter_rng.random())
+                if give_up is not None:
+                    sleep = min(sleep, max(0.0, give_up - time.monotonic()))
+                time.sleep(sleep)
 
     def reveal_aggregation(
         self, aggregation_id: AggregationId, snapshot_id: Optional[SnapshotId] = None

@@ -248,7 +248,14 @@ class SdaHttpClient(SdaService):
             raise PermissionDenied(body)
         if response.status_code == 400:
             raise InvalidRequest(body)
-        raise ServerError(f"HTTP {response.status_code}: {body}")
+        error = ServerError(f"HTTP {response.status_code}: {body}")
+        # a terminal 5xx/429 that exhausted the transport's own retries
+        # may still carry the server's Retry-After (breaker-open and
+        # admission sheds do): stamp it so HIGHER-level pollers —
+        # await_result's round-status loop — back off on the server's
+        # schedule instead of their fixed cadence
+        error.retry_after = _retry_after_seconds(response)
+        raise error
 
     def _use_bin(self) -> bool:
         """Whether the hot routes should speak binary right now."""

@@ -62,10 +62,12 @@ from ..protocol import (
     SdaError,
     Snapshot,
     SnapshotId,
+    StoreUnavailable,
     signed_encryption_key_from_obj,
 )
 from ..protocol import bincodec
 from ..server import SdaServerService, auth_token
+from ..server import health as _health
 from ..server import lifecycle as _lifecycle
 from ..server.routing import NODE_HEADER
 from ..utils import metrics
@@ -437,8 +439,13 @@ class _Handler(BaseHTTPRequestHandler):
             return re.fullmatch(pattern, path)
 
         # failpoint: transient transport trouble BEFORE any service work —
-        # injected 500s, response delays, or hard connection drops
-        action = chaos.evaluate("http.server.request")
+        # injected 500s, response delays, or hard connection drops. The
+        # claimed agent id rides the ctx so a `partition` spec can sever
+        # exactly one agent<->server pair (agent=<id>)
+        action = chaos.evaluate(
+            "http.server.request",
+            ctx={"agent": self._agent_key()} if chaos.registry.active()
+            else None)
         if action is not None:
             if action.kind == "error":
                 return self._reply(500, {"error": str(action.exc)})
@@ -592,6 +599,17 @@ class _Handler(BaseHTTPRequestHandler):
             return self._reply(400, {"error": f"{type(e).__name__}: {e}"})
         except NotFound as e:
             return self._reply(404, {"error": str(e)}, resource_not_found=True)
+        except StoreUnavailable as e:
+            # breaker-open shed (server/breaker.py): the store was never
+            # touched — 503 + Retry-After, same contract as admission
+            # sheds, so the retrying transport backs off and resubmits.
+            # No stack trace: an open breaker shedding is WORKING, and a
+            # brownout would otherwise flood the log at request rate.
+            metrics.count("http.store_unavailable")
+            if self._span is not None:
+                self._span.set_attribute("store_unavailable", True)
+            return self._reply(503, {"error": str(e)},
+                               retry_after=e.retry_after)
         except SdaError as e:
             log.exception("server error")
             return self._reply(500, {"error": str(e)})
@@ -705,6 +723,9 @@ class SdaHttpServer:
 
         service = self.httpd.sda_service  # type: ignore[attr-defined]
         gauges = metrics.gauge_report("http.inflight")
+        # unwrap a breaker proxy: the page names the BACKEND, not the wrap
+        agents_store = getattr(service.server.agents_store, "_inner",
+                               service.server.agents_store)
         return {
             "node_id": self.node_id,
             "fleet": {
@@ -713,8 +734,7 @@ class SdaHttpServer:
             },
             "uptime_s": round(time.time() - self._started_at, 3),
             # backend module name ("memory"/"sqlite"/"jsonfs"/"mongo")
-            "store": type(service.server.agents_store).__module__
-            .rsplit(".", 1)[-1],
+            "store": type(agents_store).__module__.rsplit(".", 1)[-1],
             "inflight": gauges.get("http.inflight", 0),
             "inflight_peak": gauges.get("http.inflight.peak", 0),
             "admission_enabled": self.admission.enabled,
@@ -735,6 +755,16 @@ class SdaHttpServer:
             # terminal diagnoses — the fleet's shared-store view, so any
             # worker's scrape shows every round
             "rounds": _lifecycle.rounds_report(service.server),
+            # live fleet health table (server/health.py): every worker's
+            # heartbeat state and age, read from the shared store — any
+            # worker's scrape shows the whole fleet
+            "fleet_health": _health.fleet_health_report(
+                service.server.clerking_job_store),
+            # store circuit breaker (server/breaker.py): present only
+            # when armed (sdad --store-breaker)
+            "breaker": (service.server.store_breaker.report()
+                        if getattr(service.server, "store_breaker", None)
+                        is not None else None),
             # fleet drills arm failpoints per worker (sdad --chaos-spec);
             # the scrape proves the faults actually fired in THIS process
             "failpoints": chaos.report() or {},

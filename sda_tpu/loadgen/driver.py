@@ -83,6 +83,10 @@ class LoadProfile:
     # plane (snapshot/status/clerk polls) to the aggregation's affinity
     # node (docs/scaling.md)
     fleet: int = 0
+    # fleet health plane (server/health.py): when set, every worker
+    # heartbeats this often and runs the failure detector (dead after 4
+    # intervals) — the report's fleet_health table shows the live verdict
+    heartbeat_s: Optional[float] = None
 
 
 def _percentiles_ms(summary: dict) -> dict:
@@ -185,6 +189,13 @@ def run_load(profile: LoadProfile) -> dict:
         # path arms admission/chaos AFTER setup — fleet setup traffic is
         # tiny, so whole-run arming keeps the workers stateless.
         extra = ["--job-lease", str(profile.lease_seconds), "--statusz"]
+        if profile.heartbeat_s is not None:
+            # the gray-failure plane: heartbeats + the failure detector
+            # riding each worker's sweeper (suspect at 2 intervals, dead
+            # at 4 — the conventional heartbeat multiples)
+            extra += ["--heartbeat", str(profile.heartbeat_s),
+                      "--dead-after", str(4 * profile.heartbeat_s),
+                      "--round-sweep", str(profile.heartbeat_s)]
         if profile.rate_limit is not None:
             extra += ["--rate-limit", str(profile.rate_limit),
                       "--rate-burst", str(profile.rate_burst)]
@@ -601,6 +612,12 @@ def run_load(profile: LoadProfile) -> dict:
                           for s in drain_summaries),
             "released_leases": sum(int(s.get("released_leases", 0) or 0)
                                    for s in drain_summaries),
+            # the fleet's own health verdict at the end of the run (any
+            # scrape shows the whole shared-store table): a healthy drill
+            # must end with every worker alive
+            "health": next(
+                (doc.get("fleet_health") for doc in final_scrapes.values()
+                 if doc.get("fleet_health")), None),
         }
     return report
 

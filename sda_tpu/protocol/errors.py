@@ -42,7 +42,28 @@ class NotFound(SdaError):
 
 
 class ServerError(SdaError):
-    """Internal server failure (HTTP 500)."""
+    """Internal server failure (HTTP 500).
+
+    ``retry_after`` (seconds, optional) is stamped on instances that know
+    when the condition clears — the HTTP client copies the server's
+    ``Retry-After`` hint here on terminal 5xx responses, and pollers
+    (``SdaClient.await_result``) honor it instead of their fixed cadence.
+    """
+
+    retry_after = None
+
+
+class StoreUnavailable(ServerError):
+    """The storage backend is browning out and the circuit breaker is
+    OPEN (``server/breaker.py``): the operation was shed WITHOUT touching
+    the store. Maps to HTTP 503 + ``Retry-After`` — the client-side
+    immutable-document cache keeps reads flowing and the retrying
+    transport resubmits writes once the breaker half-opens."""
+
+    def __init__(self, message: str = "store unavailable",
+                 retry_after: float = None):
+        super().__init__(message)
+        self.retry_after = retry_after
 
 
 class RoundFailed(SdaError):

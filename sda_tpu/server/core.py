@@ -92,6 +92,15 @@ class SdaServer:
         # what graceful drain hands back to the fleet (release_held_leases)
         self._granted_leases: dict = {}
         self._granted_lock = threading.Lock()
+        #: straggler hedging (server/health.py): when set to a staleness
+        #: threshold in seconds, an empty lease poll may hedge a job whose
+        #: holder's heartbeat is that stale — the hedged copy races the
+        #: suspect, result commit stays single-winner. None = off.
+        self.hedge_suspect_after_s: Optional[float] = None
+        # suspect-set cache: one heartbeat census per poll would make the
+        # hot empty-poll path a store scan; a short TTL is plenty (the
+        # detector's own cadence is coarser than this)
+        self._suspects_cache: tuple = (0.0, [])
         #: per-phase round deadlines for the lifecycle supervisor
         #: (lifecycle.py); the default (all None) tracks states but never
         #: expires anything — arm via sdad --round-collect-deadline /
@@ -198,13 +207,48 @@ class SdaServer:
                 metrics.count("server.snapshot.created")
 
     # -- clerking ----------------------------------------------------------
+    def _suspect_nodes(self) -> list:
+        """Fleet workers that currently look unhealthy (stale heartbeat or
+        an explicit suspect mark) — the hedging plane's shadow-execution
+        targets. TTL-cached so empty polls stay cheap."""
+        if self.hedge_suspect_after_s is None:
+            return []
+        now = time.monotonic()
+        cached_at, suspects = self._suspects_cache
+        if now - cached_at < 0.5:
+            return suspects
+        from . import health
+
+        suspects = health.suspect_nodes(
+            self.clerking_job_store, self.hedge_suspect_after_s,
+            exclude=self.node_id)
+        self._suspects_cache = (now, suspects)
+        return suspects
+
     def poll_clerking_job(self, clerk: AgentId) -> Optional[ClerkingJob]:
         with obs.span("server.poll_job",
                       attributes={"clerk": str(clerk)}) as poll_span:
             if self.clerking_lease_seconds is not None:
                 leased = self.clerking_job_store.lease_clerking_job(
-                    clerk, self.clerking_lease_seconds
+                    clerk, self.clerking_lease_seconds, owner=self.node_id
                 )
+                if leased is None:
+                    # straggler hedging: nothing unleased, but a job held
+                    # by a SUSPECT worker may be hedged — the poller runs
+                    # a speculative copy; whichever result lands first
+                    # wins the single-winner commit, so a slow-but-alive
+                    # holder costs duplicated work, never correctness
+                    suspects = self._suspect_nodes()
+                    if suspects:
+                        leased = self.clerking_job_store.hedge_clerking_job(
+                            clerk, suspects, self.clerking_lease_seconds,
+                            owner=self.node_id)
+                        if leased is not None:
+                            poll_span.set_attribute("hedged", True)
+                            metrics.count("server.job.hedged")
+                            obs.add_event("job.hedged",
+                                          job=str(leased[0].id),
+                                          suspects=",".join(suspects))
                 job = None
                 if leased is not None:
                     job, expires = leased

@@ -178,6 +178,26 @@ class Fleet:
                  {w.node_id: w.address for w in self.workers})
         return self
 
+    def kill(self, node_id: str) -> FleetWorker:
+        """SIGKILL one worker — no drain, no lease handback, no drained
+        line: the ungraceful death the gray-failure plane exists for.
+        The worker's heartbeat goes stale, a peer's failure detector
+        declares it dead and recalls its held leases
+        (``server/health.py``); this method only delivers the blow."""
+        worker = next((w for w in self.workers if w.node_id == node_id),
+                      None)
+        if worker is None:
+            raise ValueError(f"no fleet worker named {node_id!r}")
+        if worker.process is not None and worker.process.poll() is None:
+            worker.process.kill()
+            worker.process.wait()
+        if worker._pump is not None:
+            worker._pump.join(timeout=5.0)
+        worker.returncode = (worker.process.returncode
+                             if worker.process is not None else None)
+        log.warning("fleet worker %s SIGKILLed (no drain)", node_id)
+        return worker
+
     def stop(self, timeout_s: float = 30.0) -> List[dict]:
         """SIGTERM every worker (graceful drain), reap, return the drain
         summaries. Stragglers past the timeout are SIGKILLed and reported

@@ -415,7 +415,7 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
             obj = _read_json(self.root / "queue" / str(clerk) / f"{ids[0]}.json")
             return ClerkingJob.from_obj(obj)
 
-    def lease_clerking_job(self, clerk, lease_seconds, now=None):
+    def lease_clerking_job(self, clerk, lease_seconds, now=None, owner=None):
         chaos.fail("store.poll_clerking_job")
         now = time.time() if now is None else now
         with self._lock, self._dir_lock(self.root / "queue" / str(clerk)):
@@ -435,7 +435,8 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
                 if lease is not None:
                     metrics.count("server.job.reissued")
                 expires = now + lease_seconds
-                _write_json(qdir / f".lease-{job_id}.json", {"expires": expires})
+                _write_json(qdir / f".lease-{job_id}.json",
+                            {"expires": expires, "node": owner})
                 return ClerkingJob.from_obj(obj), expires
             return None
 
@@ -454,6 +455,89 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
                                  and lease["expires"] != expires):
                 return False
             lease_path.unlink(missing_ok=True)
+            return True
+
+    def recall_clerking_job_leases(self, node_id):
+        # the dead-node recovery step: unlink every lease file the dead
+        # worker stamped, per clerk dir under that dir's flock (the same
+        # arbitration the grant path takes, so a racing peer sweeper and
+        # a racing poll serialize cleanly)
+        recalled = 0
+        base = self.root / "queue"
+        with self._lock:
+            if not base.is_dir():
+                return 0
+            for clerk_dir in sorted(p for p in base.iterdir() if p.is_dir()):
+                with self._dir_lock(clerk_dir):
+                    for job_id in _ids_in(clerk_dir):
+                        lease_path = clerk_dir / f".lease-{job_id}.json"
+                        lease = _read_json(lease_path)
+                        if lease is None or lease.get("node") != node_id:
+                            continue
+                        lease_path.unlink(missing_ok=True)
+                        recalled += 1
+        return recalled
+
+    def hedge_clerking_job(self, clerk, suspect_nodes, lease_seconds,
+                           now=None, owner=None):
+        # hedged execution: overwrite a SUSPECT holder's ACTIVE lease
+        # with this caller's, under the clerk dir's flock (two hedging
+        # processes race the same read-check-write; one wins). The
+        # original holder may still finish — the done-move is what
+        # commits, exactly once
+        suspects = set(str(n) for n in suspect_nodes)
+        if not suspects:
+            return None
+        now = time.time() if now is None else now
+        with self._lock, self._dir_lock(self.root / "queue" / str(clerk)):
+            qdir = self.root / "queue" / str(clerk)
+            for job_id in _ids_in(qdir):
+                lease = _read_json(qdir / f".lease-{job_id}.json")
+                if lease is None or lease["expires"] <= now:
+                    continue  # unleased/lapsed: the normal poll covers it
+                if str(lease.get("node")) not in suspects:
+                    continue
+                obj = _read_json(qdir / f"{job_id}.json")
+                if obj is None:
+                    continue  # done-move by a peer since the listing
+                expires = now + lease_seconds
+                _write_json(qdir / f".lease-{job_id}.json",
+                            {"expires": expires, "node": owner})
+                return ClerkingJob.from_obj(obj), expires
+            return None
+
+    # -- fleet heartbeats ---------------------------------------------------
+    def put_worker_heartbeat(self, doc):
+        with self._lock:
+            _write_json(self.root / "heartbeats" / f"{doc['node']}.json", doc)
+
+    def get_worker_heartbeat(self, node):
+        with self._lock:
+            return _read_json(self.root / "heartbeats" / f"{node}.json")
+
+    def list_worker_heartbeats(self):
+        with self._lock:
+            out = []
+            base = self.root / "heartbeats"
+            if not base.is_dir():
+                return out
+            for name in sorted(p.stem for p in base.glob("*.json")
+                               if not p.name.startswith(".")):
+                doc = _read_json(base / f"{name}.json")
+                if doc is not None:
+                    out.append(doc)
+            return out
+
+    def transition_worker_state(self, node, from_states, doc):
+        # single-winner CAS across fleet worker processes: the dir flock
+        # makes the read-check-write atomic (same shape as
+        # transition_round_state)
+        with self._lock, self._dir_lock(self.root / "heartbeats"):
+            path = self.root / "heartbeats" / f"{node}.json"
+            current = _read_json(path)
+            if current is None or current.get("state") not in from_states:
+                return False
+            _write_json(path, doc)
             return True
 
     def list_snapshot_jobs(self, snapshot):
@@ -492,7 +576,13 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
 
     def create_clerking_result(self, result):
         chaos.fail("store.create_clerking_result")
-        with self._lock:
+        # the clerk dir flock makes the read-check-commit atomic across
+        # fleet worker PROCESSES (the in-process lock cannot): when a
+        # hedged copy races the original holder, exactly one performs the
+        # queue->done move — the second finds the queue file gone, sees
+        # the done marker, and drops its duplicate on the floor
+        with self._lock, \
+                self._dir_lock(self.root / "queue" / str(result.clerk)):
             queue_path = self.root / "queue" / str(result.clerk) / f"{result.job}.json"
             obj = _read_json(queue_path)
             if obj is None:

@@ -320,11 +320,23 @@ class RoundSweeper:
     quorum-degraded completion or unrecoverable failure. All actions are
     CAS transitions, so N workers sweeping one shared store perform each
     action exactly once between them.
+
+    ``heartbeat_suspect_s`` / ``heartbeat_dead_s`` additionally arm the
+    FLEET failure detector (``server/health.py``) on the same cadence: a
+    peer worker whose heartbeat goes stale past the suspect threshold is
+    declared suspect (hedging may shadow its jobs), past the dead
+    threshold it is declared dead and its held clerking-job leases are
+    proactively recalled — bounded MTTR instead of per-job lease expiry.
+    Both declarations ride the same single-winner CAS discipline.
     """
 
-    def __init__(self, server, interval_s: float = 1.0):
+    def __init__(self, server, interval_s: float = 1.0, *,
+                 heartbeat_suspect_s: Optional[float] = None,
+                 heartbeat_dead_s: Optional[float] = None):
         self.server = server
         self.interval_s = float(interval_s)
+        self.heartbeat_suspect_s = heartbeat_suspect_s
+        self.heartbeat_dead_s = heartbeat_dead_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -355,6 +367,18 @@ class RoundSweeper:
         t0 = time.perf_counter()
         actions: List[dict] = []
         with obs.span("server.round.sweep") as sweep_span:
+            if self.heartbeat_dead_s is not None:
+                # fleet health first: a recalled lease makes the jobs of a
+                # SIGKILL'd worker pollable before the round diagnosis
+                # below could mistake them for dead-clerk work
+                from . import health
+
+                suspect_s = (self.heartbeat_suspect_s
+                             if self.heartbeat_suspect_s is not None
+                             else self.heartbeat_dead_s / 2)
+                actions.extend(health.sweep_worker_health(
+                    self.server, now, suspect_after_s=suspect_s,
+                    dead_after_s=self.heartbeat_dead_s))
             docs = self.server.aggregation_store.list_round_states()
             by_state: dict = {}
             for doc in docs:

@@ -253,6 +253,8 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
         self._done: Dict[AgentId, Dict[ClerkingJobId, ClerkingJob]] = {}
         self._results: Dict[SnapshotId, OrderedDict] = {}
         self._leases: Dict[ClerkingJobId, float] = {}  # job id -> expires_at
+        self._lease_owners: Dict[ClerkingJobId, str] = {}  # -> node_id
+        self._heartbeats: Dict[str, dict] = {}  # node id -> heartbeat doc
 
     def enqueue_clerking_job(self, job):
         chaos.fail("store.enqueue_clerking_job")
@@ -279,7 +281,7 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
                 return None
             return next(iter(queue.values()))
 
-    def lease_clerking_job(self, clerk, lease_seconds, now=None):
+    def lease_clerking_job(self, clerk, lease_seconds, now=None, owner=None):
         chaos.fail("store.poll_clerking_job")
         now = time.time() if now is None else now
         with self._lock:
@@ -291,6 +293,7 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
                     metrics.count("server.job.reissued")
                 expires = now + lease_seconds
                 self._leases[job.id] = expires
+                self._lease_owners[job.id] = owner
                 return job, expires
             return None
 
@@ -306,6 +309,66 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
             if current is None or (expires is not None and current != expires):
                 return False
             del self._leases[job]
+            self._lease_owners.pop(job, None)
+            return True
+
+    def recall_clerking_job_leases(self, node_id):
+        # the dead-node recovery step: every lease the dead worker granted
+        # goes back to "unleased" so any peer's next poll reissues it now
+        with self._lock:
+            recalled = [
+                job_id for job_id, owner in self._lease_owners.items()
+                if owner == node_id and job_id in self._leases
+            ]
+            for job_id in recalled:
+                self._leases.pop(job_id, None)
+                self._lease_owners.pop(job_id, None)
+            return len(recalled)
+
+    def hedge_clerking_job(self, clerk, suspect_nodes, lease_seconds,
+                           now=None, owner=None):
+        # hedged execution: re-grant a SUSPECT holder's active lease to
+        # this caller; the original may still finish — result commit is
+        # single-winner, so the race is safe
+        now = time.time() if now is None else now
+        suspects = set(suspect_nodes)
+        if not suspects:
+            return None
+        with self._lock:
+            for job in self._queues.get(clerk, OrderedDict()).values():
+                expiry = self._leases.get(job.id)
+                if expiry is None or expiry <= now:
+                    continue  # unleased/lapsed: the normal poll covers it
+                if self._lease_owners.get(job.id) not in suspects:
+                    continue
+                expires = now + lease_seconds
+                self._leases[job.id] = expires
+                self._lease_owners[job.id] = owner
+                return job, expires
+            return None
+
+    # -- fleet heartbeats ---------------------------------------------------
+    def put_worker_heartbeat(self, doc):
+        with self._lock:
+            self._heartbeats[doc["node"]] = dict(doc)
+
+    def get_worker_heartbeat(self, node):
+        with self._lock:
+            doc = self._heartbeats.get(str(node))
+            return None if doc is None else dict(doc)
+
+    def list_worker_heartbeats(self):
+        with self._lock:
+            return [dict(d) for d in self._heartbeats.values()]
+
+    def transition_worker_state(self, node, from_states, doc):
+        # single-winner CAS under the store lock (the fleet contract:
+        # exactly one sweeper declares a node suspect/dead)
+        with self._lock:
+            current = self._heartbeats.get(str(node))
+            if current is None or current.get("state") not in from_states:
+                return False
+            self._heartbeats[str(node)] = dict(doc)
             return True
 
     def list_snapshot_jobs(self, snapshot):
@@ -340,6 +403,7 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
                 raise NotFound("job not found for clerk")
             if job is not None:
                 self._leases.pop(job.id, None)
+                self._lease_owners.pop(job.id, None)
                 self._done.setdefault(result.clerk, {})[job.id] = job
                 self._results.setdefault(job.snapshot, OrderedDict())[result.job] = result
 

@@ -403,7 +403,7 @@ class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
         )
         return None if doc is None else ClerkingJob.from_obj(doc["doc"])
 
-    def lease_clerking_job(self, clerk, lease_seconds, now=None):
+    def lease_clerking_job(self, clerk, lease_seconds, now=None, owner=None):
         chaos.fail("store.poll_clerking_job")
         now = time.time() if now is None else now
         expires = now + lease_seconds
@@ -416,7 +416,7 @@ class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
                     {"leased_until": {"$lte": now}},
                 ],
             },
-            {"$set": {"leased_until": expires}},
+            {"$set": {"leased_until": expires, "leased_by": owner}},
             sort=[("_id", 1)],
         )
         if doc is None:
@@ -434,9 +434,67 @@ class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
         result = self.db.clerking_jobs.update_one(
             {"_id": str(job), "clerk": str(clerk), "done": False,
              "leased_until": {"$gt": 0} if expires is None else expires},
-            {"$set": {"leased_until": 0}},
+            {"$set": {"leased_until": 0, "leased_by": None}},
         )
         return result.matched_count > 0
+
+    def recall_clerking_job_leases(self, node_id):
+        # the dead-node recovery step: one bulk conditional update drops
+        # every active lease the dead worker granted
+        result = self.db.clerking_jobs.update_many(
+            {"leased_by": str(node_id), "done": False,
+             "leased_until": {"$gt": 0}},
+            {"$set": {"leased_until": 0, "leased_by": None}},
+        )
+        return int(getattr(result, "modified_count", None)
+                   or getattr(result, "matched_count", 0) or 0)
+
+    def hedge_clerking_job(self, clerk, suspect_nodes, lease_seconds,
+                           now=None, owner=None):
+        # hedged execution: one atomic find_one_and_update re-grants a
+        # SUSPECT holder's active lease to this caller (two hedgers race,
+        # the filter matches exactly once); result commit stays
+        # single-winner on the done flag
+        suspects = [str(n) for n in suspect_nodes]
+        if not suspects:
+            return None
+        now = time.time() if now is None else now
+        expires = now + lease_seconds
+        doc = self.db.clerking_jobs.find_one_and_update(
+            {"clerk": str(clerk), "done": False,
+             "leased_until": {"$gt": now},
+             "leased_by": {"$in": suspects}},
+            {"$set": {"leased_until": expires, "leased_by": owner}},
+            sort=[("_id", 1)],
+        )
+        if doc is None:
+            return None
+        return ClerkingJob.from_obj(doc["doc"]), expires
+
+    # -- fleet heartbeats ---------------------------------------------------
+    def put_worker_heartbeat(self, doc):
+        self.db.worker_heartbeats.replace_one(
+            {"_id": doc["node"]},
+            {"_id": doc["node"], "state": doc["state"], "doc": doc},
+            upsert=True,
+        )
+
+    def get_worker_heartbeat(self, node):
+        found = self.db.worker_heartbeats.find_one({"_id": str(node)})
+        return None if found is None else found["doc"]
+
+    def list_worker_heartbeats(self):
+        return [d["doc"]
+                for d in self.db.worker_heartbeats.find({}).sort("_id", 1)]
+
+    def transition_worker_state(self, node, from_states, doc):
+        # single-winner CAS: one atomic find_one_and_update filtered on
+        # the FROM state (same shape as transition_round_state)
+        found = self.db.worker_heartbeats.find_one_and_update(
+            {"_id": str(node), "state": {"$in": list(from_states)}},
+            {"$set": {"state": doc["state"], "doc": doc}},
+        )
+        return found is not None
 
     def list_snapshot_jobs(self, snapshot):
         # the sweeper's dead-clerk census: only the queue metadata fields

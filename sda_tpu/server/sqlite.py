@@ -93,6 +93,7 @@ CREATE TABLE IF NOT EXISTS snapshot_masks (
 CREATE TABLE IF NOT EXISTS clerking_jobs (
     id TEXT NOT NULL, clerk TEXT NOT NULL, snapshot TEXT NOT NULL,
     done INTEGER NOT NULL DEFAULT 0, leased_until REAL NOT NULL DEFAULT 0,
+    leased_by TEXT NOT NULL DEFAULT '',
     doc TEXT NOT NULL,
     PRIMARY KEY (clerk, id));
 CREATE INDEX IF NOT EXISTS ix_jobs_queue ON clerking_jobs (clerk, done, id);
@@ -101,6 +102,8 @@ CREATE TABLE IF NOT EXISTS clerking_results (
     PRIMARY KEY (snapshot, job));
 CREATE TABLE IF NOT EXISTS rounds (
     aggregation TEXT PRIMARY KEY, state TEXT NOT NULL, doc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS worker_heartbeats (
+    node TEXT PRIMARY KEY, state TEXT NOT NULL, doc TEXT NOT NULL);
 """
 
 
@@ -162,6 +165,13 @@ class SqliteDb:
                 self.conn.execute(
                     "ALTER TABLE clerking_jobs "
                     "ADD COLUMN leased_until REAL NOT NULL DEFAULT 0"
+                )
+            if "leased_by" not in cols:
+                # pre-gray-failure databases: the lease-owner column the
+                # heartbeat recall / hedging plane keys on
+                self.conn.execute(
+                    "ALTER TABLE clerking_jobs "
+                    "ADD COLUMN leased_by TEXT NOT NULL DEFAULT ''"
                 )
 
     @contextlib.contextmanager
@@ -568,7 +578,7 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
         )
         return None if row is None else ClerkingJob.from_obj(json.loads(row[0]))
 
-    def lease_clerking_job(self, clerk, lease_seconds, now=None):
+    def lease_clerking_job(self, clerk, lease_seconds, now=None, owner=None):
         chaos.fail("store.poll_clerking_job")
         now = time.time() if now is None else now
         # select + stamp in ONE immediate transaction: two processes
@@ -587,9 +597,9 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
                 metrics.count("server.job.reissued")
             expires = now + lease_seconds
             self.db.conn.execute(
-                "UPDATE clerking_jobs SET leased_until = ? "
+                "UPDATE clerking_jobs SET leased_until = ?, leased_by = ? "
                 "WHERE clerk = ? AND id = ?",
-                (expires, str(clerk), job_id),
+                (expires, owner or "", str(clerk), job_id),
             )
             return ClerkingJob.from_obj(json.loads(doc)), expires
 
@@ -599,13 +609,85 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
         # Compare-and-release: with `expires` the UPDATE only matches the
         # exact lease this caller was granted — a lapsed lease re-granted
         # to a peer has a new leased_until and stays the peer's
-        sql = ("UPDATE clerking_jobs SET leased_until = 0 "
+        sql = ("UPDATE clerking_jobs SET leased_until = 0, leased_by = '' "
                "WHERE clerk = ? AND id = ? AND done = 0 AND leased_until > 0")
         args = [str(clerk), str(job)]
         if expires is not None:
             sql += " AND leased_until = ?"
             args.append(expires)
         cursor = self._exec(sql, tuple(args))
+        return cursor.rowcount > 0
+
+    def recall_clerking_job_leases(self, node_id):
+        # the dead-node recovery step: ONE conditional UPDATE drops every
+        # active lease the dead worker granted — any process's next poll
+        # reissues them immediately (autocommit: its own transaction)
+        cursor = self._exec(
+            "UPDATE clerking_jobs SET leased_until = 0, leased_by = '' "
+            "WHERE leased_by = ? AND done = 0 AND leased_until > 0",
+            (str(node_id),),
+        )
+        return cursor.rowcount
+
+    def hedge_clerking_job(self, clerk, suspect_nodes, lease_seconds,
+                           now=None, owner=None):
+        # hedged execution: re-grant a SUSPECT holder's ACTIVE lease to
+        # this caller inside one immediate transaction (two hedgers race,
+        # one wins); the original holder may still finish — result commit
+        # stays single-winner on the done flag
+        suspects = [str(n) for n in suspect_nodes]
+        if not suspects:
+            return None
+        now = time.time() if now is None else now
+        with self.db.immediate():
+            row = self.db.conn.execute(
+                "SELECT id, doc FROM clerking_jobs "
+                "WHERE clerk = ? AND done = 0 AND leased_until > ? "
+                f"AND leased_by IN ({','.join('?' * len(suspects))}) "
+                "ORDER BY id LIMIT 1",
+                (str(clerk), now, *suspects),
+            ).fetchone()
+            if row is None:
+                return None
+            job_id, doc = row
+            expires = now + lease_seconds
+            self.db.conn.execute(
+                "UPDATE clerking_jobs SET leased_until = ?, leased_by = ? "
+                "WHERE clerk = ? AND id = ?",
+                (expires, owner or "", str(clerk), job_id),
+            )
+            return ClerkingJob.from_obj(json.loads(doc)), expires
+
+    # -- fleet heartbeats ---------------------------------------------------
+    def put_worker_heartbeat(self, doc):
+        self._exec(
+            "INSERT INTO worker_heartbeats (node, state, doc) "
+            "VALUES (?, ?, ?) "
+            "ON CONFLICT (node) DO UPDATE SET "
+            "state = excluded.state, doc = excluded.doc",
+            (doc["node"], doc["state"], json.dumps(doc)),
+        )
+
+    def get_worker_heartbeat(self, node):
+        row = self._one(
+            "SELECT doc FROM worker_heartbeats WHERE node = ?", (str(node),)
+        )
+        return None if row is None else json.loads(row[0])
+
+    def list_worker_heartbeats(self):
+        rows = self._all("SELECT doc FROM worker_heartbeats ORDER BY node")
+        return [json.loads(r[0]) for r in rows]
+
+    def transition_worker_state(self, node, from_states, doc):
+        # single-winner CAS across OS processes: one conditional UPDATE,
+        # rowcount says whether THIS sweeper's declaration won (same
+        # shape as transition_round_state)
+        from_states = tuple(str(s) for s in from_states)
+        cursor = self._exec(
+            "UPDATE worker_heartbeats SET state = ?, doc = ? "
+            f"WHERE node = ? AND state IN ({','.join('?' * len(from_states))})",
+            (doc["state"], json.dumps(doc), str(node), *from_states),
+        )
         return cursor.rowcount > 0
 
     def list_snapshot_jobs(self, snapshot):

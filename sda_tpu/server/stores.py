@@ -261,7 +261,8 @@ class ClerkingJobsStore(BaseStore):
         stays visible until its result lands)."""
 
     def lease_clerking_job(
-        self, clerk: AgentId, lease_seconds: float, now: Optional[float] = None
+        self, clerk: AgentId, lease_seconds: float,
+        now: Optional[float] = None, owner: Optional[str] = None,
     ) -> Optional[Tuple[ClerkingJob, float]]:
         """Pull the clerk's next undone job that is not under an active
         lease and stamp a new lease on it; returns ``(job, expires_at)``.
@@ -272,6 +273,12 @@ class ClerkingJobsStore(BaseStore):
         *reissued* — returned again to whichever live poller asks first
         (``server.job.reissued``). Backends without native lease support
         inherit this fallback, which degrades to the plain visible-poll.
+
+        ``owner`` names the fleet worker granting the lease (the server's
+        ``node_id``): backends record it so the gray-failure plane can
+        proactively recall EVERY lease a dead worker held
+        (``recall_clerking_job_leases``) and hedge a suspect worker's
+        jobs (``hedge_clerking_job``) without waiting out per-job expiry.
         """
         job = self.poll_clerking_job(clerk)
         if job is None:
@@ -297,6 +304,70 @@ class ClerkingJobsStore(BaseStore):
         No-op (False) on done jobs and on backends without lease
         support."""
         return False
+
+    def recall_clerking_job_leases(self, node_id: str) -> int:
+        """Drop EVERY active lease granted by fleet worker ``node_id`` —
+        the failure detector's recovery step once that worker is declared
+        dead (``server/health.py``): any peer's next poll reissues the
+        work immediately instead of waiting out per-job lease expiry.
+        Done jobs are untouched (their results already landed). Returns
+        how many leases were recalled; 0 on backends without lease-owner
+        support (per-job expiry remains the fallback)."""
+        return 0
+
+    def hedge_clerking_job(
+        self, clerk: AgentId, suspect_nodes, lease_seconds: float,
+        now: Optional[float] = None, owner: Optional[str] = None,
+    ) -> Optional[Tuple[ClerkingJob, float]]:
+        """Straggler hedging (the Tail-at-Scale hedged-request move, at
+        clerking-job granularity): grant THIS caller a lease on the
+        clerk's next undone job even though it is actively leased — but
+        ONLY when the current holder is one of ``suspect_nodes`` (a
+        worker whose heartbeat went stale without being declared dead).
+        The hedged copy races the original; commit stays single-winner
+        via the store-arbitrated conditional result insert, so duplicate
+        partial sums are impossible and the verdict stays bit-exact.
+        Returns ``(job, expires_at)`` or None; None on backends without
+        lease-owner support."""
+        return None
+
+    # -- fleet heartbeats (server/health.py) --------------------------------
+    # The four in-repo backends override these with durable, contended-safe
+    # implementations; the base fallbacks keep third-party stores working
+    # (in-memory, NOT crash- or fleet-safe).
+
+    def _fallback_heartbeats(self) -> dict:
+        beats = getattr(self, "_base_heartbeats", None)
+        if beats is None:
+            beats = self._base_heartbeats = {}
+        return beats
+
+    def put_worker_heartbeat(self, doc: dict) -> None:
+        """Unconditionally upsert a worker heartbeat row (keyed by
+        ``doc["node"]``) — each worker writes only its own."""
+        self._fallback_heartbeats()[doc["node"]] = dict(doc)
+
+    def get_worker_heartbeat(self, node: str) -> Optional[dict]:
+        doc = self._fallback_heartbeats().get(str(node))
+        return None if doc is None else dict(doc)
+
+    def list_worker_heartbeats(self) -> List[dict]:
+        return [dict(d) for d in self._fallback_heartbeats().values()]
+
+    def transition_worker_state(self, node: str, from_states,
+                                doc: dict) -> bool:
+        """Conditional publish: install ``doc`` iff the stored heartbeat's
+        current ``state`` is one of ``from_states`` — the single-winner
+        CAS that lets N fleet sweepers race a suspect/dead declaration
+        and guarantees exactly one performs it (and recalls the dead
+        node's leases exactly once); same contract as
+        ``transition_round_state``."""
+        beats = self._fallback_heartbeats()
+        current = beats.get(str(node))
+        if current is None or current.get("state") not in from_states:
+            return False
+        beats[str(node)] = dict(doc)
+        return True
 
     @abc.abstractmethod
     def get_clerking_job(

@@ -155,8 +155,10 @@ class FakeCollection:
 
     def update_many(self, query: Dict[str, Any], update: Dict[str, Any]):
         with self._lock:
-            for doc in self._find(query):
+            found = self._find(query)
+            for doc in found:
                 _apply_update(self._docs[doc["_id"]], update)
+            return _UpdateResult(len(found))
 
     def count_documents(self, query: Dict[str, Any]) -> int:
         with self._lock:
