@@ -111,6 +111,45 @@ PY
 python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$BROWNOUT_RECORD"
 rm -f "$BROWNOUT_RECORD"
 
+echo "== churn drill (fixed seed: ~40% participant churn, crash mid-upload + journal resume + duplicate retries; bit-exact, zero double counts)"
+CHURN=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --chaos --churn 0.35 \
+  --chaos-store sqlite --chaos-seed 20260803 --chaos-rate 0.05)
+CHURN_RECORD=$(mktemp /tmp/sda-churn-XXXX.json)
+CHURN="$CHURN" CHURN_RECORD="$CHURN_RECORD" python - <<'PY'
+import json, os
+report = json.loads(os.environ["CHURN"].strip().splitlines()[-1])
+# the exactly-once verdict: nonzero churn actually happened, every
+# departure rejoined via its journal, mid-upload crashes replayed
+# byte-identically, the equivocation probe was rejected, and the round
+# revealed bit-exactly with ZERO double-counted participations
+assert report["exact"] is True, report
+assert report["participants_churned"] >= 1, report
+assert report["participants_resumed"] == report["participants_churned"], report
+assert report["participations_replayed"] >= 1, report
+assert report["equivocations_undetected"] == 0, report
+assert report["equivocations_detected"] >= 1, report
+assert report["double_counted"] == 0, report
+record = {
+    "metric": "churn drill resume wall (12 participants, ~40% churn, journal resume over HTTP)",
+    "value": report["time_to_resume_s"], "unit": "seconds",
+    "platform": "cpu", "seed": report["seed"],
+    "churn_rate": report["churn_rate"],
+    "participants_resumed": report["participants_resumed"],
+}
+with open(os.environ["CHURN_RECORD"], "w") as f:
+    json.dump(record, f)
+print(f"churn drill OK: {report['participants_churned']} churned, "
+      f"{report['participants_resumed']} resumed, "
+      f"{report['participations_replayed']} replayed, "
+      f"equivocations detected={report['equivocations_detected']} "
+      f"undetected={report['equivocations_undetected']}, "
+      f"double_counted={report['double_counted']}, exact={report['exact']}")
+PY
+# the resume-wall record must parse as a bench record and gate (advisory:
+# first record of its metric seeds the trailing window)
+python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$CHURN_RECORD"
+rm -f "$CHURN_RECORD"
+
 echo "== wire codec A/B (fixed seed: same round JSON vs binary, bit-exact both ways)"
 CODEC_JSON=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 16 --dim 64 \
   --load-arrivals closed --load-concurrency 4 --load-seed 20260803 \

@@ -13,7 +13,11 @@ PORT=$(( (RANDOM % 10000) + 20000 ))
 URL="http://127.0.0.1:$PORT"
 
 echo "== starting sdad (sqlite store) on $URL"
-python -m sda_tpu.cli.serverd --sqlite "$WORK/server.db" httpd --bind "127.0.0.1:$PORT" &
+# sdad's stdout goes to a log: its shutdown "drained" line must not race
+# the reveal for the last line of the walkthrough's own output (ci.sh
+# asserts on `tail -1`)
+python -m sda_tpu.cli.serverd --sqlite "$WORK/server.db" httpd \
+  --bind "127.0.0.1:$PORT" > "$WORK/sdad.log" 2>&1 &
 SERVER_PID=$!
 for _ in $(seq 50); do
   python -m sda_tpu.cli.main -s "$URL" -i "$WORK/probe" ping >/dev/null 2>&1 && break

@@ -371,6 +371,47 @@ def reset() -> None:
     registry.clear()
 
 
+def churn_schedule(agents: int, rate: float, seed: int = 0) -> list:
+    """Seeded per-agent device-churn plan — the sporadic-device model
+    (PAPER.md's weak phones) made deterministic, the same ``(seed, name)``
+    RNG discipline every failpoint keeps.
+
+    Each of ``agents`` entries decides whether that agent DEPARTS during
+    its participation (probability ``rate``) and, for departures, at
+    which crash point — alternating deterministically by departure
+    ordinal so any plan with at least one departure exercises both:
+
+    - ``"mid-upload"`` (first, third, ... departure): the crash lands
+      AFTER the server durably stored the bundle but BEFORE the device
+      learned of it — the lost-ack window, the ``kill`` analog of the
+      ``http.server.response`` drop. The rejoin's journal resume is a
+      byte-identical replay (``server.participation.replayed``).
+    - ``"pre-upload"`` (second, fourth, ...): the crash lands after
+      sealing + journaling but before any upload; the rejoin's resume is
+      the bundle's FIRST arrival.
+
+    Every departure rejoins (``"rejoins": True``) — permanent death
+    already has its own failpoint (``participant.dies``, kind ``kill``)
+    and composes freely with this plan. Drills iterate the plan; the
+    drill, not this schedule, performs the crash/rejoin, which keeps the
+    plan reusable by both ``sda-sim --chaos --churn`` and the loadgen
+    churn knob (docs/robustness.md)."""
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"churn rate {rate} outside [0, 1]")
+    rng = random.Random(f"{seed}:churn")
+    plan = []
+    departures = 0
+    for index in range(agents):
+        departs = rng.random() < rate
+        phase = None
+        if departs:
+            phase = "mid-upload" if departures % 2 == 0 else "pre-upload"
+            departures += 1
+        plan.append({"index": index, "departs": departs, "phase": phase,
+                     "rejoins": departs})
+    return plan
+
+
 #: spec keys -> coercion; None means "keep the string"
 _SPEC_KEYS = {
     "rate": float, "times": int, "every": int, "after": int,

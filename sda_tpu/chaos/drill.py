@@ -25,6 +25,7 @@ shows which injected fault lengthened the round.
 
 from __future__ import annotations
 
+import tempfile
 import time
 from typing import List
 
@@ -49,6 +50,7 @@ def run_chaos_drill(
     clerking_deadline_s: float = 1.5,
     sweep_interval_s: float = 0.2,
     brownout_s: float = 0.0,
+    churn_rate: float = 0.0,
 ) -> dict:
     """Run one full aggregation round over HTTP under injected faults.
 
@@ -74,6 +76,23 @@ def run_chaos_drill(
     ``time_to_recover_s`` (MTTR: first trip -> final recovery), the
     fixed-seed record ci.sh feeds the bench regression gate.
 
+    ``churn_rate`` arms the DEVICE-churn drill (the participant-plane
+    mirror of the gray-failure drills): a seeded fraction of participants
+    departs mid-round per :func:`sda_tpu.chaos.churn_schedule` — sealing
+    and journaling their participation
+    (``client/journal.ParticipationJournal``), then crashing either
+    before the upload or in the lost-ack window right after the server
+    stored it — and every departure later REJOINS as a fresh client
+    process resuming from the journal. Exactly-once ingestion must make
+    the round reveal bit-exactly with ZERO double-counted participations:
+    pre-upload crashes land on resume as first arrivals, mid-upload
+    crashes as byte-identical replays (``server.participation.replayed``).
+    The drill also runs one deliberate equivocation probe (the first
+    churned agent re-participates with fresh randomness): the server must
+    reject it with ``ParticipationConflict``
+    (``server.participation.equivocation``), and
+    ``equivocations_undetected`` must stay 0.
+
     ``extra_spec`` is one spec string or a list of them (the repeatable
     ``--chaos-spec`` flag), merged with conflict rejection.
 
@@ -84,6 +103,7 @@ def run_chaos_drill(
     import numpy as np
 
     from ..client import SdaClient
+    from ..client.journal import ParticipationJournal
     from ..crypto import MemoryKeystore, sodium
     from ..http import SdaHttpClient, SdaHttpServer
     from ..protocol import (
@@ -92,6 +112,7 @@ def run_chaos_drill(
         AggregationId,
         FullMasking,
         PackedShamirSharing,
+        ParticipationConflict,
         RoundFailed,
         ServerError,
         SodiumEncryption,
@@ -156,6 +177,10 @@ def run_chaos_drill(
         sweeper = lifecycle.RoundSweeper(
             service_impl.server, interval_s=sweep_interval_s).start()
 
+    # the churned devices' journal: a real directory, because the whole
+    # point is surviving process death — rejoined clients read it cold
+    journal_dir = tempfile.TemporaryDirectory(prefix="sda-churn-journal-")
+
     http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
     http_server.start_background()
     try:
@@ -165,9 +190,8 @@ def run_chaos_drill(
         with obs.span("round", attributes={"profile": "chaos",
                                            "participants": participants,
                                            "seed": seed}):
-            def new_client():
-                keystore = MemoryKeystore()
-                proxy = SdaHttpClient(
+            def new_proxy():
+                return SdaHttpClient(
                     http_server.address,
                     token="chaos-drill-token",
                     # fast, deterministic-budget retries: the drill injects a
@@ -180,8 +204,11 @@ def run_chaos_drill(
                     backoff_base=0.01,
                     backoff_cap=0.25 if brownout_s else 0.1,
                 )
+
+            def new_client():
+                keystore = MemoryKeystore()
                 agent = SdaClient.new_agent(keystore)
-                return SdaClient(agent, keystore, proxy)
+                return SdaClient(agent, keystore, new_proxy())
 
             # -- clean setup (no injection yet: the drill targets the round)
             recipient = new_client()
@@ -241,15 +268,70 @@ def run_chaos_drill(
             rng = np.random.default_rng(seed)
             inputs = rng.integers(0, modulus,
                                   size=(participants, dim), dtype=np.int64)
+            churn_plan = (chaos.churn_schedule(participants, churn_rate,
+                                               seed=seed)
+                          if churn_rate else None)
+            journal = (ParticipationJournal(journal_dir.name)
+                       if churn_rate else None)
             # a dead participant never contributes: the healthy-reference
             # sum covers exactly the rows that actually reached the round
             alive_rows = []
-            for row in inputs:
+            departed = []  # (agent, row): crashed devices awaiting rejoin
+            for i, row in enumerate(inputs):
                 participant = new_client()
                 participant.upload_agent()
-                participant.participate([int(x) for x in row], agg.id)
+                plan = churn_plan[i] if churn_plan else None
+                if plan and plan["departs"]:
+                    # the sporadic device: seal + journal, then crash at
+                    # the scheduled point — BEFORE any upload, or in the
+                    # lost-ack window right after the server stored the
+                    # bundle (the device never learns it landed)
+                    participation = participant.new_participation(
+                        [int(x) for x in row], agg.id)
+                    journal.record(participation)
+                    if plan["phase"] == "mid-upload":
+                        participant.upload_participation(participation)
+                    metrics.count("participant.departed")
+                    departed.append((participant.agent, row))
+                    # the departure WILL land: every plan entry rejoins,
+                    # and resume re-uploads the journaled bytes below
+                    alive_rows.append(row)
+                    continue
+                participant.participate([int(x) for x in row], agg.id,
+                                        journal=journal)
                 if not participant._dead:
                     alive_rows.append(row)
+
+            # -- rejoin: each departed device comes back as a FRESH client
+            # process (new transport, empty keystore — resume needs only
+            # the journaled bytes and the agent identity) and re-uploads
+            # verbatim: pre-upload crashes arrive for the first time,
+            # mid-upload crashes replay byte-identically
+            resumed = 0
+            equivocations_undetected = 0
+            resume_started = time.perf_counter()
+            for agent, _row in departed:
+                rejoined = SdaClient(agent, MemoryKeystore(), new_proxy())
+                resumed += rejoined.resume(journal)
+            time_to_resume_s = time.perf_counter() - resume_started
+            if departed:
+                # the equivocation probe: the first churned agent tries to
+                # participate AGAIN with fresh randomness and a different
+                # input — exactly the double-count the exactly-once plane
+                # exists to stop. Detection = typed ParticipationConflict.
+                agent, row = departed[0]
+                probe = SdaClient(agent, MemoryKeystore(), new_proxy())
+                try:
+                    # upload directly (not participate()): the probe is an
+                    # upload-level attack and must reach the server even
+                    # when a leftover participant.dies kill budget would
+                    # silently swallow a participate() call
+                    probe.upload_participation(probe.new_participation(
+                        [int(x + 1) % modulus for x in row], agg.id))
+                except ParticipationConflict:
+                    pass  # detected: counted server-side as equivocation
+                else:
+                    equivocations_undetected += 1
             recipient.end_aggregation(agg.id)  # snapshot + job fan-out
 
             brownout_started = None
@@ -337,6 +419,18 @@ def run_chaos_drill(
                         "dead_clerks": [str(c) for c in e.dead_clerks],
                     }
             final_round = round_state() or final_round
+            # zero-double-count audit: the aggregation-wide admitted count
+            # must equal the unique devices that ever landed — a surplus
+            # is a double count, the exact failure exactly-once ingestion
+            # exists to make impossible
+            admitted = None
+            try:
+                final_status = recipient.service.get_aggregation_status(
+                    recipient.agent, agg.id)
+                if final_status is not None:
+                    admitted = final_status.number_of_participations
+            except Exception:  # chaos'd poll: the audit is best-effort
+                pass
     finally:
         # snapshot the schedule, then disarm BEFORE shutdown so teardown
         # requests aren't chaos'd
@@ -345,6 +439,7 @@ def run_chaos_drill(
         if sweeper is not None:
             sweeper.stop()
         http_server.shutdown()
+        journal_dir.cleanup()
 
     from ..loadgen import latency_report_ms as _latency_report_ms
 
@@ -407,6 +502,23 @@ def run_chaos_drill(
         "brownout_s": brownout_s or None,
         "breaker": breaker_report,
         "time_to_recover_s": (breaker_report or {}).get("time_to_recover_s"),
+        # device-churn verdict (exactly-once participation plane): every
+        # departure rejoined and landed exactly once — mid-upload crashes
+        # as byte-identical replays, the equivocation probe rejected, and
+        # the admitted count exactly the unique-device count
+        "churn_rate": churn_rate or None,
+        "participants_churned": len(departed),
+        "participants_resumed": resumed,
+        "participations_replayed": counters.get(
+            "server.participation.replayed", 0),
+        "equivocations_detected": counters.get(
+            "server.participation.equivocation", 0),
+        "equivocations_undetected": equivocations_undetected,
+        "admitted_participations": admitted,
+        "double_counted": (None if admitted is None
+                           else admitted - len(alive_rows)),
+        "time_to_resume_s": (round(time_to_resume_s, 4)
+                             if churn_rate else None),
         "failure": failure,
         "injected_faults": injected,
         "failed_requests": failed_requests,
@@ -416,6 +528,7 @@ def run_chaos_drill(
             k: v for k, v in counters.items()
             if k.startswith(("chaos.", "http.retry.", "http.status.",
                              "server.job.", "server.snapshot.",
+                             "server.participation.", "participant.",
                              "server.store.breaker.", "server.fleet."))
         },
         # per-route server latency under fire: the tail the retry budget

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -28,6 +29,7 @@ from ..protocol import (
     NotFound,
     PackedPaillierEncryption,
     PackedShamirSharing,
+    ParticipationConflict,
     SodiumEncryption,
 )
 from ..store import Filebased
@@ -133,6 +135,19 @@ def build_parser() -> argparse.ArgumentParser:
                       help="compute the participation in the native C "
                            "core (the embeddable-client path: additive "
                            "or Shamir sharing, Sodium encryption)")
+    part.add_argument("--journal", action="store_true",
+                      help="durable exactly-once participation: persist "
+                           "the sealed bundle under "
+                           "<identity>/journal/ BEFORE the first upload "
+                           "so a crash can be recovered with `sda "
+                           "resume` — same bytes, no recompute, no "
+                           "double count (docs/client.md)")
+
+    sub.add_parser(
+        "resume",
+        help="re-upload this identity's journaled participations after a "
+             "crash (`participate --journal`); byte-identical replays are "
+             "deduped server-side, so resuming is always safe")
 
     return parser
 
@@ -493,6 +508,11 @@ def main(argv=None) -> int:
                   "or --model FILE)", file=sys.stderr)
             return 1
         if args.embedded:
+            if args.journal:
+                print("error: --journal needs the Python participation "
+                      "path (the embedded C core uploads internally); "
+                      "drop --embedded", file=sys.stderr)
+                return 1
             from ..client.embed import participate_embedded
 
             try:
@@ -502,8 +522,37 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 return 1
         else:
-            client.participate(values, agg_id)
+            journal = None
+            if args.journal:
+                from ..client.journal import ParticipationJournal
+
+                journal = ParticipationJournal(
+                    os.path.join(args.identity, "journal"))
+            try:
+                client.participate(values, agg_id, journal=journal)
+            except ParticipationConflict as e:
+                print(f"error: the server already holds a participation "
+                      f"for this identity in {agg_id} — one device, one "
+                      f"contribution per round ({e})", file=sys.stderr)
+                return 1
         return 0
+
+    if args.command == "resume":
+        from ..client.journal import ParticipationJournal
+
+        journal = ParticipationJournal(
+            os.path.join(args.identity, "journal"))
+        pending = len(journal)
+        if not pending:
+            print("nothing journaled; all participations confirmed")
+            return 0
+        # re-register first: resume may follow a server restart that lost
+        # the auth-token row (same rule as participate)
+        client.upload_agent()
+        resumed = client.resume(journal)
+        print(f"resumed {resumed} of {pending} journaled "
+              f"participation(s); {len(journal)} still pending")
+        return 0 if len(journal) == 0 else 1
 
     return 1
 

@@ -89,6 +89,15 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--load-chaos-rate", type=float, default=0.0,
                         help="combined load+chaos drill: also 500 this "
                              "fraction of requests (--load)")
+    parser.add_argument("--load-churn", type=float, metavar="RATE",
+                        default=0.0,
+                        help="device churn under load: this seeded "
+                             "fraction of participants crashes mid-"
+                             "participation (journal written, upload "
+                             "possibly in the lost-ack window) and "
+                             "rejoins via journal resume; the capacity "
+                             "report carries the resume/replay counters "
+                             "(--load; docs/load.md)")
     parser.add_argument("--load-codec", choices=["auto", "json", "bin"],
                         default="auto",
                         help="wire codec for the swarm: auto (negotiate "
@@ -139,6 +148,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "circuit breaker; the round must still "
                              "reveal bit-exactly and the report records "
                              "the breaker's time_to_recover_s MTTR "
+                             "(docs/robustness.md)")
+    parser.add_argument("--churn", type=float, metavar="RATE", default=0.0,
+                        help="device-churn drill (--chaos): this seeded "
+                             "fraction of participants crashes mid-round "
+                             "— before the upload or in the lost-ack "
+                             "window after the server stored it — then "
+                             "rejoins as a fresh process resuming its "
+                             "journaled participation; the round must "
+                             "reveal bit-exactly with zero double-counted "
+                             "participations and the injected "
+                             "equivocation probe rejected "
                              "(docs/robustness.md)")
     parser.add_argument("--dead-clerks", type=int, metavar="K", default=0,
                         help="permanently kill K clerks (clerk.dies kill "
@@ -313,6 +333,7 @@ def _run_load(args) -> int:
                 rate_limit=rate,
                 rate_burst=4.0 if burst is None else burst,
                 chaos_rate=chaos_rate,
+                churn=args.load_churn,
                 codec=args.load_codec,
             ),
             nodes=args.load_fleet,
@@ -339,6 +360,7 @@ def _run_load(args) -> int:
             rate_limit=rate,
             rate_burst=4.0 if burst is None else burst,
             chaos_rate=chaos_rate,
+            churn=args.load_churn,
             codec=args.load_codec,
         ))
     _export_trace(args, report)
@@ -381,6 +403,7 @@ def _run_chaos(args) -> int:
             dead_clerks=args.dead_clerks,
             sharing=args.chaos_sharing,
             brownout_s=args.brownout,
+            churn_rate=args.churn,
         )
     _export_trace(args, report)
     print(json.dumps(report))
@@ -392,6 +415,21 @@ def _run_chaos(args) -> int:
         breaker = report.get("breaker") or {}
         brownout_ok = (breaker.get("times_opened", 0) > 0
                        and breaker.get("time_to_recover_s") is not None)
+    churn_ok = True
+    if args.churn:
+        # the exactly-once verdict: every departure resumed, nothing
+        # double-counted, the equivocation probe rejected — and when the
+        # seeded plan produced any churn at all, at least one resume
+        churn_ok = (
+            # the admitted-count audit is best-effort (a chaos'd status
+            # poll leaves it None): gate only on an ACTUAL surplus
+            report["double_counted"] in (0, None)
+            and report["equivocations_undetected"] == 0
+            and report["participants_resumed"]
+            == report["participants_churned"]
+            and (report["participants_churned"] > 0
+                 or args.churn < 0.05)
+        )
     if args.dead_clerks and args.chaos_sharing == "additive":
         # additive cannot survive a dead clerk: success is a DETERMINISTIC
         # terminal 'failed' with a machine-readable reason (no hang)
@@ -405,7 +443,7 @@ def _run_chaos(args) -> int:
               and report.get("round_state") in ("degraded", "revealed"))
     else:
         ok = bool(report["exact"])
-    return 0 if ok and brownout_ok else 1
+    return 0 if ok and brownout_ok and churn_ok else 1
 
 
 def main(argv=None) -> int:

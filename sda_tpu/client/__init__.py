@@ -33,6 +33,7 @@ from ..protocol import (
     NotFound,
     PackedPaillierEncryption,
     Participation,
+    ParticipationConflict,
     ParticipationId,
     Profile,
     RoundExpired,
@@ -238,8 +239,18 @@ class SdaClient:
     # ------------------------------------------------------------------
     # Participating (participate.rs)
 
-    def participate(self, input: Sequence[int], aggregation: AggregationId) -> None:
-        """new_participation + upload in one go (participate.rs:31-35)."""
+    def participate(self, input: Sequence[int], aggregation: AggregationId,
+                    *, journal=None) -> None:
+        """new_participation + upload in one go (participate.rs:31-35).
+
+        With ``journal`` (a :class:`~sda_tpu.client.journal.\
+ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
+        first upload attempt and reaped after the confirmed upload — the
+        durable half of exactly-once participation: a crash anywhere in
+        between leaves the sealed bytes on disk for
+        :meth:`resume` to re-upload verbatim (same randomness, same id,
+        so the server dedupes instead of double-counting;
+        docs/client.md)."""
         # permanent-death failpoint (chaos drills): a participant that
         # dies never contributes — the round's expected sum must exclude
         # it (PAPER.md's sporadic phones, made injectable)
@@ -252,7 +263,71 @@ class SdaClient:
             return
         with obs.span("participant.participate",
                       attributes={"aggregation": str(aggregation)}):
-            self.upload_participation(self.new_participation(input, aggregation))
+            if journal is not None:
+                pending = journal.load(self.agent.id, aggregation)
+                if pending is not None:
+                    # a previous attempt crashed between seal and confirm:
+                    # re-upload ITS bytes — recomputing would mint fresh
+                    # randomness and a new id, the exact double-count (or
+                    # conflict) the journal exists to prevent, and would
+                    # overwrite the only bytes that can replay idempotently
+                    metrics.count("participant.journal.recovered")
+                    self.upload_participation(pending)
+                    journal.reap(self.agent.id, aggregation)
+                    return
+            participation = self.new_participation(input, aggregation)
+            if journal is not None:
+                journal.record(participation)
+                metrics.count("participant.journaled")
+            self.upload_participation(participation)
+            if journal is not None:
+                journal.reap(self.agent.id, aggregation)
+
+    def resume(self, journal) -> int:
+        """Re-upload every journaled participation of THIS agent — the
+        crash-recovery path of :meth:`participate`.
+
+        The journal holds fully sealed bundles, so resume never
+        recomputes: the SAME bytes go back out, and the server's
+        exactly-once ingestion either inserts them (the crash hit before
+        the upload) or recognizes the byte-identical replay (the crash
+        ate the ack) — in neither case can the device double-count.
+        Entries are reaped on success and on the terminal outcomes where
+        re-uploading is moot: ``NotFound`` (the aggregation is gone) and
+        ``ParticipationConflict`` (the server already holds a DIFFERENT
+        bundle under our key — possible only if something else uploaded
+        for this agent; counted, surfaced in logs, not raised, so one
+        poisoned entry cannot wedge the resume loop). Transient transport
+        errors leave the entry journaled for the next resume.
+
+        Returns how many entries were re-uploaded successfully
+        (``participant.resumed``)."""
+        resumed = 0
+        for participation in journal.pending(self.agent.id):
+            with obs.span("participant.resume",
+                          attributes={
+                              "aggregation": str(participation.aggregation),
+                              "participation": str(participation.id)}):
+                try:
+                    self.upload_participation(participation)
+                except NotFound:
+                    # the aggregation is gone (deleted / expired server
+                    # side): the entry can never land — reap it
+                    metrics.count("participant.resume.orphaned")
+                    journal.reap(self.agent.id, participation.aggregation)
+                    continue
+                except ParticipationConflict as e:
+                    log.warning(
+                        "resume %s: server already holds a different "
+                        "bundle for this agent (%s); reaping the journal "
+                        "entry", participation.aggregation, e)
+                    metrics.count("participant.resume.conflict")
+                    journal.reap(self.agent.id, participation.aggregation)
+                    continue
+            journal.reap(self.agent.id, participation.aggregation)
+            metrics.count("participant.resumed")
+            resumed += 1
+        return resumed
 
     def new_participation(
         self, input: Sequence[int], aggregation_id: AggregationId
@@ -816,3 +891,13 @@ class SdaClient:
             output = unmasker.unmask(mask, masked_output)
         return RecipientOutput(modulus=aggregation.modulus, values=output,
                                participations=result.number_of_participations)
+
+
+#: Role alias for the participant-side workflow: the reference splits the
+#: client across Participating/Clerking/Receiving traits; here one class
+#: carries all three, and ``SdaParticipant`` names the participating view
+#: where only ``participate(..., journal=...)`` / ``resume(journal)``
+#: matter — the durable sporadic-device entry points (docs/client.md).
+SdaParticipant = SdaClient
+
+from .journal import ParticipationJournal  # noqa: E402  (re-export)

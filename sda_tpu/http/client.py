@@ -36,6 +36,7 @@ from ..protocol import (
     InvalidRequest,
     NotFound,
     Participation,
+    ParticipationConflict,
     PermissionDenied,
     Pong,
     RoundStatus,
@@ -248,6 +249,14 @@ class SdaHttpClient(SdaService):
             raise PermissionDenied(body)
         if response.status_code == 400:
             raise InvalidRequest(body)
+        if response.status_code == 409:
+            # exactly-once ingestion refused the upload: TERMINAL by
+            # construction (the server already holds a different bundle
+            # under this key — replaying the same bytes can never turn a
+            # conflict into a success), so it is deliberately not in
+            # RETRYABLE_STATUSES and surfaces typed after ONE attempt
+            metrics.count("http.participation.conflict")
+            raise ParticipationConflict(body)
         error = ServerError(f"HTTP {response.status_code}: {body}")
         # a terminal 5xx/429 that exhausted the transport's own retries
         # may still carry the server's Retry-After (breaker-open and
@@ -386,7 +395,7 @@ class SdaHttpClient(SdaService):
             self._request("GET", path, params=params, auth=self._auth(caller))
         )
 
-    def _post(self, caller: Agent, path: str, obj, resource=None) -> None:
+    def _post(self, caller: Agent, path: str, obj, resource=None):
         # POSTs are only retry-safe because every mutating route is a
         # create-once/idempotent upsert server-side — enforce the claim
         # (explicit raise, not `assert`: must survive python -O)
@@ -399,15 +408,14 @@ class SdaHttpClient(SdaService):
             # negotiated hot-route body: one binary frame instead of
             # base64-inside-JSON; the raw bytes re-send identically on
             # retries, so retry semantics are unchanged
-            self._check(self._request(
+            return self._check(self._request(
                 "POST", path, data=bincodec.encode(resource),
                 headers={"Content-Type": bincodec.CONTENT_TYPE},
                 auth=self._auth(caller),
             ))
-            return
         # ``obj`` may be a thunk so hot callers skip building the (large)
         # JSON tree when the binary path was taken
-        self._check(
+        return self._check(
             self._request("POST", path, json=obj() if callable(obj) else obj,
                           auth=self._auth(caller))
         )
@@ -519,8 +527,14 @@ class SdaHttpClient(SdaService):
         )
 
     def create_participation(self, caller, participation):
-        self._post(caller, "/v1/aggregations/participations",
-                   participation.to_obj, resource=participation)
+        if self._post(caller, "/v1/aggregations/participations",
+                      participation.to_obj, resource=participation) is None:
+            # X-Resource-Not-Found 404: the aggregation is gone. The
+            # in-process seam raises here, and resume() relies on the
+            # distinction to reap orphaned journal entries instead of
+            # miscounting them as resumed — mirror it.
+            raise NotFound(
+                f"unknown aggregation {participation.aggregation}")
 
     def get_clerking_job(self, caller, clerk):
         headers = None

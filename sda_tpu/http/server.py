@@ -57,6 +57,7 @@ from ..protocol import (
     InvalidRequest,
     NotFound,
     Participation,
+    ParticipationConflict,
     PermissionDenied,
     Profile,
     SdaError,
@@ -599,6 +600,13 @@ class _Handler(BaseHTTPRequestHandler):
             return self._reply(400, {"error": f"{type(e).__name__}: {e}"})
         except NotFound as e:
             return self._reply(404, {"error": str(e)}, resource_not_found=True)
+        except ParticipationConflict as e:
+            # exactly-once ingestion rejected an equivocating upload: 409
+            # is TERMINAL for the retrying transport (re-sending the same
+            # conflicting bytes can never succeed), unlike the transient
+            # 5xx/429 family. No stack trace — detection is the feature
+            # working, and a buggy device would flood the log.
+            return self._reply(409, {"error": str(e)})
         except StoreUnavailable as e:
             # breaker-open shed (server/breaker.py): the store was never
             # touched — 503 + Retry-After, same contract as admission
@@ -750,6 +758,11 @@ class SdaHttpServer:
             # contended-idempotency visibility: how often this worker's
             # snapshot pipeline won, lost, or converged on a peer's freeze
             "snapshot": metrics.counter_report("server.snapshot.") or {},
+            # exactly-once ingestion visibility: created vs byte-identical
+            # replays vs rejected equivocations (fleet loadgen sums these
+            # across scrapes — the counters live in THIS process)
+            "participation": metrics.counter_report(
+                "server.participation.") or {},
             # round lifecycle table (server/lifecycle.py): per-state
             # tallies + the most recently updated rounds with their
             # terminal diagnoses — the fleet's shared-store view, so any

@@ -43,12 +43,14 @@ from __future__ import annotations
 
 import concurrent.futures
 import random
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, replace
 from typing import List, Optional
 
 from .. import chaos, obs
+from ..client.journal import ParticipationJournal
 from ..utils import metrics
 
 
@@ -71,6 +73,12 @@ class LoadProfile:
     rate_burst: float = 4.0
     # combined load+chaos drill: fraction of requests to 500 (0 = off)
     chaos_rate: float = 0.0
+    # device churn under load (chaos.churn_schedule): this seeded fraction
+    # of participants crashes mid-participation — sealed bundle journaled,
+    # upload possibly already durable with the ack lost — and rejoins as a
+    # fresh client resuming from the journal; the report's ``churn`` block
+    # carries the resume/replay counters (docs/load.md)
+    churn: float = 0.0
     lease_seconds: float = 2.0
     timeout_s: float = 300.0
     # wire codec for every client in the swarm: "auto" (upgrade on the
@@ -225,6 +233,9 @@ def run_load(profile: LoadProfile) -> dict:
 
         http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
         http_server.start_background()
+    # churned devices journal to a real directory — resume reads it as a
+    # fresh process would (exactly-once participation, docs/robustness.md)
+    journal_dir = tempfile.TemporaryDirectory(prefix="sda-load-journal-")
     failures: List[str] = []
     failures_lock = threading.Lock()
     try:
@@ -324,6 +335,39 @@ def run_load(profile: LoadProfile) -> dict:
                                   size=(profile.participants, profile.dim),
                                   dtype=np.int64)
 
+            churn_plan = (chaos.churn_schedule(profile.participants,
+                                               profile.churn,
+                                               seed=profile.seed)
+                          if profile.churn else None)
+            journal = (ParticipationJournal(journal_dir.name)
+                       if profile.churn else None)
+            churn_stats = {"churned": 0, "resumed": 0}
+            churn_lock = threading.Lock()
+
+            def churned_participate(participant, index: int) -> None:
+                """The sporadic device under load: seal + journal, crash
+                at the seeded point (pre-upload, or mid-upload with the
+                ack lost), then rejoin as a fresh client resuming the
+                journaled bytes — exactly-once ingestion makes the replay
+                idempotent, so the round's sum is unchanged."""
+                from ..client import SdaClient
+                from ..crypto import MemoryKeystore
+
+                plan = churn_plan[index]
+                participation = participant.new_participation(
+                    [int(x) for x in inputs[index]], agg.id)
+                journal.record(participation)
+                if plan["phase"] == "mid-upload":
+                    participant.upload_participation(participation)
+                # the rejoin: resume needs only the agent identity and
+                # the journal — the sealed bytes never get recomputed
+                rejoined = SdaClient(participant.agent, MemoryKeystore(),
+                                     _proxy_for(participant.agent.id))
+                resumed = rejoined.resume(journal)
+                with churn_lock:
+                    churn_stats["churned"] += 1
+                    churn_stats["resumed"] += resumed
+
             def participant_task(index: int, scheduled: float, t_open: float):
                 start = time.perf_counter()
                 if profile.arrivals == "open":
@@ -338,9 +382,12 @@ def run_load(profile: LoadProfile) -> dict:
                         metrics.observe("load.phase.register",
                                         time.perf_counter() - t0)
                         t1 = time.perf_counter()
-                        participant.participate(
-                            [int(x) for x in inputs[index]], agg.id
-                        )
+                        if churn_plan and churn_plan[index]["departs"]:
+                            churned_participate(participant, index)
+                        else:
+                            participant.participate(
+                                [int(x) for x in inputs[index]], agg.id
+                            )
                         metrics.observe("load.phase.participate",
                                         time.perf_counter() - t1)
                         return True
@@ -475,6 +522,7 @@ def run_load(profile: LoadProfile) -> dict:
         else:
             status_counts = http_server.status_counts
             http_server.shutdown()
+        journal_dir.cleanup()
 
     counters = metrics.counter_report()
     codec_counters = metrics.counter_report("http.codec.") or None
@@ -487,6 +535,18 @@ def run_load(profile: LoadProfile) -> dict:
             for name, count in (doc.get("codec_counters") or {}).items():
                 merged_codec[name] = merged_codec.get(name, 0) + count
         codec_counters = merged_codec or None
+    # exactly-once ingestion tallies are stamped server-side: in-process
+    # runs read the live counters, fleet runs merge the workers' /statusz
+    # participation blocks (the counters live in THEIR processes)
+    if fleet is not None:
+        participation_counters: dict = {}
+        for doc in final_scrapes.values():
+            for name, count in (doc.get("participation") or {}).items():
+                participation_counters[name] = (
+                    participation_counters.get(name, 0) + count)
+    else:
+        participation_counters = metrics.counter_report(
+            "server.participation.") or {}
     lag_summary = metrics.histogram_report("load.lag").get("load.lag")
     clerk_job_summary = metrics.histogram_report("clerk.job.").get(
         "clerk.job.seconds")
@@ -572,6 +632,18 @@ def run_load(profile: LoadProfile) -> dict:
         "clerk_job_ms": (_percentiles_ms(clerk_job_summary)
                          if clerk_job_summary else None),
         "lag_ms": _percentiles_ms(lag_summary) if lag_summary else None,
+        # device-churn block (LoadProfile.churn): how many participants
+        # crashed + rejoined, and the server's exactly-once verdict on
+        # their replays — created vs replayed vs rejected equivocations
+        "churn": ({
+            "rate": profile.churn,
+            "participants_churned": churn_stats["churned"],
+            "participants_resumed": churn_stats["resumed"],
+            "participations_replayed": participation_counters.get(
+                "server.participation.replayed", 0),
+            "equivocations": participation_counters.get(
+                "server.participation.equivocation", 0),
+        } if profile.churn else None),
         # the three slowest participants with the span chain that made them
         # slow (retry attempts, server handling, store ops) — tail
         # ATTRIBUTION, where the latency histograms only show tail SIZE

@@ -66,6 +66,27 @@ class StoreUnavailable(ServerError):
         self.retry_after = retry_after
 
 
+class ParticipationConflict(SdaError):
+    """Exactly-once ingestion rejected a participation upload.
+
+    The store already holds a DIFFERENT share bundle under the same key —
+    either the same ``(aggregation, participant)`` pair with other content
+    (a device that recomputed with fresh randomness instead of resuming
+    its journal, or an equivocating device submitting two inputs) or the
+    same participation id with other bytes (a buggy peer trying to
+    replace an earlier upload in place). Byte-identical replays are NOT
+    conflicts: they return success idempotently, which is what makes
+    crash/retry loops safe. Maps to HTTP 409, which the retrying
+    transport classifies terminal — retrying an equivocation cannot ever
+    succeed (docs/robustness.md)."""
+
+    def __init__(self, message: str = "participation conflict", *,
+                 participant=None, aggregation=None):
+        super().__init__(message)
+        self.participant = participant
+        self.aggregation = aggregation
+
+
 class RoundFailed(SdaError):
     """The round lifecycle supervisor declared the round terminally
     ``failed`` — e.g. a dead clerk under additive sharing (every share is

@@ -312,6 +312,19 @@ class Participation:
     def __eq__(self, other):
         return isinstance(other, Participation) and self.to_obj() == other.to_obj()
 
+    def canonical_digest(self) -> str:
+        """SHA-256 over the canonical JSON bytes of this participation —
+        the content half of the exactly-once ingestion key. Two uploads
+        with equal digests are byte-identical replays of one sealed
+        bundle (safe to dedupe); unequal digests under one
+        ``(aggregation, participant)`` key are an equivocation
+        (``ParticipationConflict``). Uses the same ``canonical_json``
+        serialization the signature layer trusts, so the digest is
+        stable across store round trips."""
+        import hashlib
+
+        return hashlib.sha256(canonical_json(self.to_obj())).hexdigest()
+
     def to_obj(self):
         return {
             "id": self.id.to_obj(),

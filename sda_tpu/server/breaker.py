@@ -53,14 +53,17 @@ from ..protocol import (
     InvalidCredentials,
     InvalidRequest,
     NotFound,
+    ParticipationConflict,
     PermissionDenied,
     StoreUnavailable,
 )
 
 #: Exception types that are protocol ANSWERS, not store failures — they
-#: pass through the breaker uncounted and unretried.
-SEMANTIC_ERRORS = (NotFound, InvalidRequest, PermissionDenied,
-                   InvalidCredentials, StoreUnavailable)
+#: pass through the breaker uncounted and unretried (a rejected
+#: equivocation is detection WORKING; a flood of equivocators must not
+#: trip the breaker).
+SEMANTIC_ERRORS = (NotFound, InvalidRequest, ParticipationConflict,
+                   PermissionDenied, InvalidCredentials, StoreUnavailable)
 
 CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
 _STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}

@@ -31,6 +31,7 @@ from ..protocol import (
     InvalidRequest,
     NotFound,
     Participation,
+    ParticipationConflict,
     PermissionDenied,
     Pong,
     Profile,
@@ -171,9 +172,25 @@ class SdaServer:
     # -- participation -----------------------------------------------------
     def create_participation(self, participation: Participation) -> None:
         with obs.span("server.create_participation",
-                      attributes={"participation": str(participation.id)}):
-            self.aggregation_store.create_participation(participation)
-        metrics.count("server.participation.created")
+                      attributes={"participation": str(participation.id)}
+                      ) as span:
+            try:
+                created = self.aggregation_store.create_participation(
+                    participation)
+            except ParticipationConflict:
+                # detected equivocation / double participation: counted
+                # here (every backend raises through this seam), mapped
+                # to HTTP 409 by the transport
+                span.set_attribute("conflict", True)
+                metrics.count("server.participation.equivocation")
+                raise
+        if created is False:
+            # byte-identical replay (crash/retry or journal resume):
+            # idempotent success, nothing changed
+            metrics.count("server.participation.replayed")
+        else:
+            # True, or None from a pre-exactly-once third-party store
+            metrics.count("server.participation.created")
 
     # -- status / snapshots ------------------------------------------------
     def get_aggregation_status(

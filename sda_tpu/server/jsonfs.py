@@ -51,6 +51,7 @@ from ..protocol import (
     Encryption,
     NotFound,
     Participation,
+    ParticipationConflict,
     Profile,
     Snapshot,
     SnapshotId,
@@ -235,7 +236,7 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
             for sid in self.list_snapshots(aggregation):
                 (self.root / "snapshot_parts" / f"{sid}.json").unlink(missing_ok=True)
                 (self.root / "masks" / f"{sid}.json").unlink(missing_ok=True)
-            for sub in ("participations", "snapshots"):
+            for sub in ("participations", "part_owners", "snapshots"):
                 shutil.rmtree(self.root / sub / str(aggregation), ignore_errors=True)
             (self.root / "aggregations" / f"{aggregation}.json").unlink(missing_ok=True)
             (self.root / "committees" / f"{aggregation}.json").unlink(missing_ok=True)
@@ -254,14 +255,54 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
 
     def create_participation(self, participation):
         chaos.fail("store.create_participation")
+        digest = participation.canonical_digest()
+        agg = str(participation.aggregation)
+        payload = (self.root / "participations" / agg
+                   / f"{participation.id}.json")
+        # the per-agent owner marker is the single-winner key: link(2)
+        # create-if-absent arbitrates across OS processes, exactly like
+        # the snapshot freeze (exactly-once ingestion contract,
+        # stores.py). Marker FIRST, payload second: a crash between the
+        # two leaves a claimed-but-unwritten slot that the replay below
+        # repairs; payload-first would leave an UNclaimed payload a
+        # recomputed bundle could double-count against.
+        owner = (self.root / "part_owners" / agg
+                 / f"{participation.participant}.json")
         with self._lock:
             if self.get_aggregation(participation.aggregation) is None:
                 raise NotFound("aggregation not found")
-            _write_json(
-                self.root / "participations" / str(participation.aggregation)
-                / f"{participation.id}.json",
-                participation.to_obj(),
-            )
+            existing = _read_json(payload)
+            if existing is not None:
+                # same participation id: byte-identical replay succeeds
+                # idempotently; different content never silently replaces
+                if Participation.from_obj(existing).canonical_digest() \
+                        == digest:
+                    # heal the marker if a pre-exactly-once writer (or a
+                    # crash) left the payload unclaimed
+                    _write_json_new(owner, {"id": str(participation.id),
+                                            "digest": digest})
+                    return False
+                raise ParticipationConflict(
+                    f"participation {participation.id} already exists "
+                    "with different content",
+                    participant=participation.participant,
+                    aggregation=participation.aggregation)
+            if _write_json_new(owner, {"id": str(participation.id),
+                                       "digest": digest}):
+                _write_json_new(payload, participation.to_obj())
+                return True
+            claimed = _read_json(owner) or {}
+            if claimed.get("digest") == digest:
+                # replay of our own bytes; re-publish the payload in case
+                # the original writer crashed between marker and payload
+                _write_json_new(payload, participation.to_obj())
+                return False
+            raise ParticipationConflict(
+                f"agent {participation.participant} already participated "
+                f"in {participation.aggregation} "
+                f"(participation {claimed.get('id')})",
+                participant=participation.participant,
+                aggregation=participation.aggregation)
 
     def create_snapshot(self, snapshot):
         chaos.fail("store.create_snapshot")

@@ -26,6 +26,7 @@ from ..protocol import (
     Committee,
     NotFound,
     Participation,
+    ParticipationConflict,
     ParticipationId,
     Snapshot,
     SnapshotId,
@@ -114,6 +115,9 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
         self._committees: Dict[AggregationId, Committee] = {}
         # insertion-ordered so snapshots freeze a deterministic set
         self._participations: Dict[AggregationId, OrderedDict] = {}
+        # exactly-once ingestion index: (aggregation, participant) ->
+        # (participation id, canonical digest) — the single-winner key
+        self._part_owners: Dict[AggregationId, Dict] = {}
         self._snapshots: Dict[AggregationId, OrderedDict] = {}
         self._snapshot_parts: Dict[SnapshotId, List[ParticipationId]] = {}
         self._snapshot_masks = {}
@@ -145,6 +149,7 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
             self._aggregations.pop(aggregation, None)
             self._committees.pop(aggregation, None)
             self._participations.pop(aggregation, None)
+            self._part_owners.pop(aggregation, None)
             self._rounds.pop(str(aggregation), None)
             for sid in self._snapshots.pop(aggregation, OrderedDict()):
                 self._snapshot_parts.pop(sid, None)
@@ -160,11 +165,40 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
 
     def create_participation(self, participation):
         chaos.fail("store.create_participation")
+        digest = participation.canonical_digest()
+        # the whole check-and-insert under ONE lock hold is the arbiter:
+        # two racing uploaders of one (aggregation, participant) key admit
+        # exactly one winner (exactly-once ingestion contract, stores.py)
         with self._lock:
             if participation.aggregation not in self._aggregations:
                 raise NotFound("aggregation not found")
-            # keyed by participation id: re-uploads (retries) are deduped
-            self._participations[participation.aggregation][participation.id] = participation
+            parts = self._participations[participation.aggregation]
+            existing = parts.get(participation.id)
+            if existing is not None:
+                # same participation id: byte-identical replay is an
+                # idempotent success; different content must never
+                # silently replace the earlier bundle
+                if existing.canonical_digest() == digest:
+                    return False
+                raise ParticipationConflict(
+                    f"participation {participation.id} already exists "
+                    "with different content",
+                    participant=participation.participant,
+                    aggregation=participation.aggregation)
+            owners = self._part_owners.setdefault(participation.aggregation, {})
+            owned = owners.get(participation.participant)
+            if owned is not None:
+                # the same agent under a NEW id: a recompute-with-fresh-
+                # randomness (or equivocation) that would double-count
+                raise ParticipationConflict(
+                    f"agent {participation.participant} already "
+                    f"participated in {participation.aggregation} "
+                    f"(participation {owned[0]})",
+                    participant=participation.participant,
+                    aggregation=participation.aggregation)
+            owners[participation.participant] = (participation.id, digest)
+            parts[participation.id] = participation
+            return True
 
     def create_snapshot(self, snapshot):
         chaos.fail("store.create_snapshot")

@@ -45,6 +45,7 @@ from ..protocol import (
     EncryptionKeyId,
     NotFound,
     Participation,
+    ParticipationConflict,
     Profile,
     Snapshot,
     SnapshotId,
@@ -175,6 +176,8 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
             self.db.snapshot_masks.delete_many({"_id": {"$in": snap_ids}})
             self.db.snapshot_freezes.delete_many({"_id": {"$in": snap_ids}})
         self.db.participations.delete_many({"aggregation": agg})
+        self.db.participation_owners.delete_many(
+            {"_id": {"$regex": f"^{agg}:"}})
         self.db.snapshots.delete_many({"aggregation": agg})
         self.db.committees.delete_one({"_id": agg})
         self.db.rounds.delete_one({"_id": agg})
@@ -192,20 +195,89 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         )
 
     @staticmethod
-    def _participation_doc(participation):
+    def _participation_doc(participation, digest):
         return {
             "_id": str(participation.id),
             "aggregation": str(participation.aggregation),
+            "participant": str(participation.participant),
+            "digest": digest,
             "snapshots": [],
             "doc": participation.to_obj(),
         }
+
+    @staticmethod
+    def _doc_digest(doc):
+        """A stored participation doc's canonical digest, recomputed for
+        legacy docs written before the digest field existed."""
+        if doc.get("digest"):
+            return doc["digest"]
+        return Participation.from_obj(doc["doc"]).canonical_digest()
 
     def create_participation(self, participation):
         chaos.fail("store.create_participation")
         if self.get_aggregation(participation.aggregation) is None:
             raise NotFound("aggregation not found")
-        doc = self._participation_doc(participation)
-        self.db.participations.replace_one({"_id": doc["_id"]}, doc, upsert=True)
+        digest = participation.canonical_digest()
+        pid = str(participation.id)
+        existing = self.db.participations.find_one({"_id": pid})
+        if existing is not None:
+            # same participation id: byte-identical replay succeeds
+            # idempotently; different content never silently replaces
+            if self._doc_digest(existing) == digest:
+                self._claim_owner(participation, digest)  # heal the marker
+                return False
+            raise ParticipationConflict(
+                f"participation {pid} already exists with different "
+                "content",
+                participant=participation.participant,
+                aggregation=participation.aggregation)
+        result = self._claim_owner(participation, digest)
+        if result.upserted_id is not None:
+            # won the (aggregation, participant) key: publish the payload
+            # with Mongo's atomic create-if-absent (a replayed loser of a
+            # crash window republishes the same bytes harmlessly)
+            self.db.participations.update_one(
+                {"_id": pid},
+                {"$setOnInsert": self._participation_doc(participation,
+                                                         digest)},
+                upsert=True,
+            )
+            return True
+        marker = self.db.participation_owners.find_one(
+            {"_id": self._owner_key(participation)}) or {}
+        if marker.get("digest") == digest:
+            # replay of our own bytes; re-publish the payload in case the
+            # original writer crashed between marker and payload
+            self.db.participations.update_one(
+                {"_id": pid},
+                {"$setOnInsert": self._participation_doc(participation,
+                                                         digest)},
+                upsert=True,
+            )
+            return False
+        raise ParticipationConflict(
+            f"agent {participation.participant} already participated in "
+            f"{participation.aggregation} (participation "
+            f"{marker.get('id')})",
+            participant=participation.participant,
+            aggregation=participation.aggregation)
+
+    @staticmethod
+    def _owner_key(participation):
+        return f"{participation.aggregation}:{participation.participant}"
+
+    def _claim_owner(self, participation, digest):
+        """$setOnInsert upsert on the per-(aggregation, participant)
+        marker — Mongo's atomic create-if-absent is the single-winner
+        arbiter (marker first, payload second: same crash-window
+        reasoning as the jsonfs backend)."""
+        return self.db.participation_owners.update_one(
+            {"_id": self._owner_key(participation)},
+            {"$setOnInsert": {"_id": self._owner_key(participation),
+                              "id": str(participation.id),
+                              "digest": digest}},
+            upsert=True,
+        )
 
     def create_snapshot(self, snapshot):
         chaos.fail("store.create_snapshot")

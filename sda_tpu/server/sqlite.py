@@ -49,6 +49,7 @@ from ..protocol import (
     EncryptionKeyId,
     NotFound,
     Participation,
+    ParticipationConflict,
     Profile,
     Snapshot,
     SnapshotId,
@@ -78,8 +79,13 @@ CREATE TABLE IF NOT EXISTS aggregations (
 CREATE TABLE IF NOT EXISTS committees (
     aggregation TEXT PRIMARY KEY, doc TEXT NOT NULL);
 CREATE TABLE IF NOT EXISTS participations (
-    id TEXT NOT NULL, aggregation TEXT NOT NULL, doc TEXT NOT NULL,
+    id TEXT NOT NULL, aggregation TEXT NOT NULL,
+    participant TEXT NOT NULL DEFAULT '',
+    digest TEXT NOT NULL DEFAULT '',
+    doc TEXT NOT NULL,
     PRIMARY KEY (aggregation, id));
+CREATE INDEX IF NOT EXISTS ix_parts_agent
+    ON participations (aggregation, participant);
 CREATE TABLE IF NOT EXISTS snapshots (
     id TEXT NOT NULL, aggregation TEXT NOT NULL, doc TEXT NOT NULL,
     PRIMARY KEY (aggregation, id));
@@ -173,6 +179,23 @@ class SqliteDb:
                     "ALTER TABLE clerking_jobs "
                     "ADD COLUMN leased_by TEXT NOT NULL DEFAULT ''"
                 )
+            # migrate pre-exactly-once databases: the participant/digest
+            # columns the single-winner participation insert keys on.
+            # Legacy rows keep '' (never matches a real agent id or
+            # digest); the read path recomputes their digest from doc.
+            part_cols = {
+                r[1] for r in self.conn.execute(
+                    "PRAGMA table_info(participations)")
+            }
+            for column in ("participant", "digest"):
+                if column not in part_cols:
+                    self.conn.execute(
+                        f"ALTER TABLE participations "
+                        f"ADD COLUMN {column} TEXT NOT NULL DEFAULT ''"
+                    )
+            self.conn.execute(
+                "CREATE INDEX IF NOT EXISTS ix_parts_agent "
+                "ON participations (aggregation, participant)")
 
     @contextlib.contextmanager
     def immediate(self):
@@ -343,8 +366,21 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
             (str(committee.aggregation), json.dumps(committee.to_obj())),
         )
 
+    @staticmethod
+    def _row_digest(digest, doc):
+        """A row's canonical digest, recomputed from the stored doc for
+        legacy rows written before the digest column existed."""
+        if digest:
+            return digest
+        return Participation.from_obj(json.loads(doc)).canonical_digest()
+
     def create_participation(self, participation):
         chaos.fail("store.create_participation")
+        digest = participation.canonical_digest()
+        # the checks and the insert share one BEGIN IMMEDIATE transaction:
+        # the write lock is the cross-process arbiter, so two racing
+        # uploaders of one key admit exactly one winner (exactly-once
+        # ingestion contract, stores.py)
         with self.db.immediate():
             exists = self.db.conn.execute(
                 "SELECT 1 FROM aggregations WHERE id = ?",
@@ -352,15 +388,49 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
             ).fetchone()
             if exists is None:
                 raise NotFound("aggregation not found")
+            row = self.db.conn.execute(
+                "SELECT digest, doc FROM participations "
+                "WHERE aggregation = ? AND id = ?",
+                (str(participation.aggregation), str(participation.id)),
+            ).fetchone()
+            if row is not None:
+                # same participation id: byte-identical replay succeeds
+                # idempotently; different content never silently replaces
+                if self._row_digest(row[0], row[1]) == digest:
+                    return False
+                raise ParticipationConflict(
+                    f"participation {participation.id} already exists "
+                    "with different content",
+                    participant=participation.participant,
+                    aggregation=participation.aggregation)
+            owned = self.db.conn.execute(
+                "SELECT id FROM participations "
+                "WHERE aggregation = ? AND participant = ?",
+                (str(participation.aggregation),
+                 str(participation.participant)),
+            ).fetchone()
+            if owned is not None:
+                # same agent under a NEW id: a recompute-with-fresh-
+                # randomness (or equivocation) that would double-count
+                raise ParticipationConflict(
+                    f"agent {participation.participant} already "
+                    f"participated in {participation.aggregation} "
+                    f"(participation {owned[0]})",
+                    participant=participation.participant,
+                    aggregation=participation.aggregation)
             self.db.conn.execute(
-                "INSERT INTO participations (id, aggregation, doc) VALUES (?, ?, ?) "
-                "ON CONFLICT (aggregation, id) DO UPDATE SET doc = excluded.doc",
+                "INSERT INTO participations "
+                "(id, aggregation, participant, digest, doc) "
+                "VALUES (?, ?, ?, ?, ?)",
                 (
                     str(participation.id),
                     str(participation.aggregation),
+                    str(participation.participant),
+                    digest,
                     json.dumps(participation.to_obj()),
                 ),
             )
+            return True
 
     def create_snapshot(self, snapshot):
         chaos.fail("store.create_snapshot")

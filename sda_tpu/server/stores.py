@@ -109,7 +109,26 @@ class AggregationsStore(BaseStore):
     def create_committee(self, committee: Committee) -> None: ...
 
     @abc.abstractmethod
-    def create_participation(self, participation: Participation) -> None: ...
+    def create_participation(self, participation: Participation) -> bool:
+        """Exactly-once ingestion: a single-winner conditional insert
+        keyed by ``(aggregation, participant)`` with the participation's
+        canonical content digest stored alongside (the same
+        contended-idempotency discipline as ``create_snapshot``, arbitrated
+        at the store so it holds across competing server processes).
+
+        - fresh key: insert, return True (this call created it);
+        - byte-identical replay (same key, same digest — a crash/retry or
+          journal resume re-uploading the SAME sealed bytes): change
+          nothing, return False (idempotent success);
+        - same key, different digest (a device that recomputed with fresh
+          randomness under a new participation id, or an equivocator
+          submitting a second input), or an existing participation id
+          being re-uploaded with different content: raise
+          ``ParticipationConflict`` — never silently replace.
+
+        Post-freeze arrivals are NOT this method's concern: they insert
+        normally and the frozen id set keeps them out of the running
+        round (``snapshot_participations``)."""
 
     @abc.abstractmethod
     def create_snapshot(self, snapshot: Snapshot) -> bool:

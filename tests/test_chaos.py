@@ -388,13 +388,16 @@ def test_snapshot_creation_is_idempotent():
         assert len(store._queues[a.id]) == 1
 
     # crash-replay flavor: the snapshot RECORD is lost (it commits last)
-    # but the frozen set survives; a late participation arrives; the
-    # replay must re-use the ORIGINAL frozen set, not re-freeze with the
-    # newcomer (mixing share generations across clerk columns)
+    # but the frozen set survives; a late participation arrives (from a
+    # FRESH device — exactly-once ingestion forbids a second bundle from
+    # the same agent); the replay must re-use the ORIGINAL frozen set,
+    # not re-freeze with the newcomer (mixing share generations across
+    # clerk columns)
     agg_store = service.server.aggregation_store
     del agg_store._snapshots[agg.id][snap.id]  # simulate the crash point
-    service.create_participation(recipient, Participation(
-        id=ParticipationId.random(), participant=recipient.id,
+    late_agent, _ = new_full_agent(service)
+    service.create_participation(late_agent, Participation(
+        id=ParticipationId.random(), participant=late_agent.id,
         aggregation=agg.id, recipient_encryption=None,
         clerk_encryptions=[(a.id, mock_encryption(b"late")) for a, _ in clerk_agents],
     ))

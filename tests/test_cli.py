@@ -66,6 +66,62 @@ def test_simple_cli_walkthrough(httpd, tmp_path, capsys):
     assert agg_id in listed
 
 
+def test_cli_journal_participate_and_resume(httpd, tmp_path, capsys):
+    """`participate --journal` + `sda resume`: a journaled upload reaps
+    its entry; a journal entry left by a 'crash' resumes to the SAME
+    bytes (deduped server-side), and the round reveals exactly."""
+    url = httpd.address
+
+    def sda(identity, *args, rc_expected=0):
+        rc = sda_main(["-s", url, "-i", str(tmp_path / "agent" / identity),
+                       *args])
+        assert rc == rc_expected
+        return capsys.readouterr().out.strip()
+
+    sda("recipient", "agent", "create")
+    sda("recipient", "agent", "keys", "create")
+    for who in ("clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "agent", "create")
+        sda(who, "agent", "keys", "create")
+    agg_id = sda(
+        "recipient", "aggregations", "create", "journaled",
+        "--dimension", "4", "--modulus", "433", "--shares", "3",
+    )
+    sda("recipient", "aggregations", "begin", agg_id)
+
+    # the happy path: journal written before the upload, reaped after
+    sda("part-1", "participate", agg_id, "1", "2", "3", "4", "--journal")
+    journal_dir = tmp_path / "agent" / "part-1" / "journal"
+    assert list(journal_dir.glob("*.json")) == []  # reaped on confirm
+    assert sda("part-1", "resume") == \
+        "nothing journaled; all participations confirmed"
+
+    # the crash path: seal + journal WITHOUT uploading (a device that
+    # died mid-participate), then `sda resume` re-uploads the same bytes
+    from sda_tpu.client import SdaClient
+    from sda_tpu.client.journal import ParticipationJournal
+    from sda_tpu.cli.main import load_client
+    from sda_tpu.protocol import AggregationId
+
+    class _Args:
+        identity = str(tmp_path / "agent" / "part-2")
+        server = url
+
+    crashed = load_client(_Args)
+    crashed.upload_agent()
+    sealed = crashed.new_participation([4, 3, 2, 1],
+                                       AggregationId(agg_id))
+    ParticipationJournal(tmp_path / "agent" / "part-2"
+                         / "journal").record(sealed)
+    out = sda("part-2", "resume")
+    assert out == "resumed 1 of 1 journaled participation(s); 0 still pending"
+
+    sda("recipient", "aggregations", "end", agg_id)
+    for who in ("recipient", "clerk-1", "clerk-2", "clerk-3"):
+        sda(who, "clerk", "--once")
+    assert sda("recipient", "aggregations", "reveal", agg_id) == "5 5 5 5"
+
+
 def test_cli_shamir_aggregation(httpd, tmp_path, capsys):
     url = httpd.address
 
