@@ -150,6 +150,52 @@ PY
 python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$CHURN_RECORD"
 rm -f "$CHURN_RECORD"
 
+echo "== tree drill (fixed seed: 2-level tree over sqlite+HTTP, ~10% leaf dropout, bit-exact vs flat reference; simulated 1e5-participant record)"
+TREE=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --tree --participants 24 --dim 4 \
+  --tree-group-size 6 --tree-seed 20260803 --tree-dropout 0.1 --tree-sim 100000)
+TREE_RECORD=$(mktemp /tmp/sda-tree-XXXX.json)
+TREE="$TREE" TREE_RECORD="$TREE_RECORD" python - <<'PY'
+import json, os
+report = json.loads(os.environ["TREE"].strip().splitlines()[-1])
+# the real-crypto rung: a 2-level tree (G leaf rounds + 1 root round)
+# over sqlite through real HTTP, leaf dropout injected, every level's
+# round revealed, and the ROOT output bit-exact against BOTH the
+# surviving-devices expectation and a real flat reference round
+assert report["depth"] == 2, report["depth"]
+assert report["groups"] >= 2, report
+assert report["exact"] is True, report
+assert report["flat_exact"] is True, report
+assert report["root_state"] == "revealed", report
+assert report["participants_dropped"] >= 1, report
+# relay accounting: one re-share per leaf group, masks forwarded in-band
+assert report["relays"] == report["groups"], report
+assert report["counters"].get("relay.masks_forwarded", 0) >= 1, report["counters"]
+# tree linkage visible on the round documents (any worker can diagnose)
+assert report["root_children"] and len(report["root_children"]) == report["groups"], report
+# the simulated population rung: fixed-seed 1e5-participant 2-level tree,
+# bit-exact vs the flat walk, peak per-node memory BOUNDED by the batch
+sim = report["sim"]
+assert sim["participants"] == 100000, sim
+assert sim["depth"] == 2, sim
+assert sim["exact"] is True, sim
+assert sim["bounded"] is True, sim
+assert sim["peak_node_elements"] <= sim["bound_elements"], sim
+# the MEASURED verdict: tracemalloc peak of the streaming pass stays
+# under the batch-derived bound, independent of the population
+assert sim["peak_pass_bytes"] <= sim["bound_pass_bytes"], sim
+with open(os.environ["TREE_RECORD"], "w") as f:
+    json.dump(sim, f)
+print(f"tree drill OK: {report['groups']} groups, "
+      f"{report['participants_dropped']} dropped, exact={report['exact']} "
+      f"flat_exact={report['flat_exact']}; sim 1e5 exact={sim['exact']} "
+      f"bounded={sim['bounded']} ({sim['value']} participants/sec)")
+PY
+# the simulated participants=1e5 record must parse as a bench record and
+# gate advisory via sda-bench --check (first record of its metric seeds
+# the trailing window; CPU rung numbers are advisory by policy)
+python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$TREE_RECORD"
+rm -f "$TREE_RECORD"
+
 echo "== wire codec A/B (fixed seed: same round JSON vs binary, bit-exact both ways)"
 CODEC_JSON=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 16 --dim 64 \
   --load-arrivals closed --load-concurrency 4 --load-seed 20260803 \

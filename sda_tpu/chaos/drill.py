@@ -33,6 +33,20 @@ from .. import chaos, obs
 from ..utils import metrics
 
 
+def golden_packed_scheme():
+    """THE drill committee: 8-clerk packed Shamir, threshold 7-of-8,
+    p=433, omega=354/150 (tests/test_fault_tolerance's golden config).
+    One definition — the chaos drill, the load drill and the tree drill
+    all compare bit-exactness against rounds built from this exact
+    scheme, so it must never drift between them."""
+    from ..protocol import PackedShamirSharing
+
+    return PackedShamirSharing(
+        secret_count=3, share_count=8, privacy_threshold=4,
+        prime_modulus=433, omega_secrets=354, omega_shares=150,
+    )
+
+
 def run_chaos_drill(
     participants: int = 6,
     dim: int = 4,
@@ -129,15 +143,12 @@ def run_chaos_drill(
         scheme = AdditiveSharing(share_count=8, modulus=433)
         modulus = scheme.modulus
     elif sharing == "packed":
-        # the golden 8-clerk packed-Shamir committee
-        # (tests/test_fault_tolerance): threshold 7 of 8, so the abandoned
-        # job is LIVENESS-critical only via reissue when every other
-        # result is present — and exactly one PERMANENTLY dead clerk still
-        # leaves a reconstructing quorum
-        scheme = PackedShamirSharing(
-            secret_count=3, share_count=8, privacy_threshold=4,
-            prime_modulus=433, omega_secrets=354, omega_shares=150,
-        )
+        # the golden committee (module-level golden_packed_scheme):
+        # threshold 7 of 8, so the abandoned job is LIVENESS-critical
+        # only via reissue when every other result is present — and
+        # exactly one PERMANENTLY dead clerk still leaves a
+        # reconstructing quorum
+        scheme = golden_packed_scheme()
         modulus = scheme.prime_modulus
     else:
         raise ValueError(f"unknown sharing {sharing!r}")

@@ -9,12 +9,14 @@ line with timing and the verification verdict.
     sda-sim --participants 100 --dim 9999 --clerks 8
     sda-sim --participants 1000 --dim 3000000 --streaming
 
-Two no-JAX drill profiles exercise the serving plane instead of the
-kernels: ``--chaos`` (fault injection, chaos/drill.py) and ``--load``
-(capacity measurement + admission control, loadgen/driver.py):
+Three no-JAX drill profiles exercise the serving plane instead of the
+kernels: ``--chaos`` (fault injection, chaos/drill.py), ``--load``
+(capacity measurement + admission control, loadgen/driver.py), and
+``--tree`` (hierarchical population-scale rounds, sda_tpu/tree):
 
     sda-sim --load --participants 200 --load-rps 150
     sda-sim --load --participants 200 --load-overload
+    sda-sim --tree --participants 24 --tree-dropout 0.1
 """
 
 from __future__ import annotations
@@ -116,6 +118,53 @@ def build_parser() -> argparse.ArgumentParser:
                         default=1,
                         help="baseline worker count for the scaling "
                              "record's speedup denominator (--load-fleet)")
+    parser.add_argument("--tree", action="store_true",
+                        help="hierarchical-aggregation profile: plan a "
+                             "multi-level tree (sda_tpu/tree), run it "
+                             "through the real HTTP stack — leaf rounds, "
+                             "relays re-sharing masked totals, root "
+                             "reveal — assert bit-exactness vs a flat "
+                             "reference round, and emit the simulated "
+                             "population-scale BENCH record "
+                             "(docs/scaling.md)")
+    parser.add_argument("--tree-group-size", type=int, default=5,
+                        help="participants per leaf group (--tree)")
+    parser.add_argument("--tree-fanout", type=int, default=None,
+                        help="max child relays per internal round; "
+                             "default: one parent absorbs every leaf "
+                             "(2-level tree) (--tree)")
+    parser.add_argument("--tree-store",
+                        choices=["memory", "sqlite", "jsonfs"],
+                        default="sqlite",
+                        help="server store backend for --tree")
+    parser.add_argument("--tree-sharing", choices=["additive", "packed"],
+                        default="additive",
+                        help="committee sharing per level: additive "
+                             "(cheap, zero dead-clerk tolerance) or "
+                             "packed Shamir (quorum completion) (--tree)")
+    parser.add_argument("--tree-mask", choices=["none", "full", "chacha"],
+                        default="chacha",
+                        help="masking scheme, shared by every level "
+                             "(--tree)")
+    parser.add_argument("--tree-dropout", type=float, default=0.0,
+                        help="seeded chaos dropout rate at the leaves "
+                             "(participant.dies kill failpoint) (--tree)")
+    parser.add_argument("--tree-dead-clerks", type=int, default=0,
+                        help="permanently kill K clerks of the first "
+                             "leaf's committee: packed degrades the leaf "
+                             "and the root stays exact; additive fails "
+                             "the leaf AND the root with a reason "
+                             "naming the leaf (--tree)")
+    parser.add_argument("--tree-seed", type=int, default=0,
+                        help="plan/input/chaos seed (--tree)")
+    parser.add_argument("--tree-sim", type=int, metavar="N",
+                        default=100_000,
+                        help="also run the simulated population-scale "
+                             "round at N participants (real planner + "
+                             "modular tree algebra, streamed batches, "
+                             "bounded per-node memory asserted) and "
+                             "attach its BENCH record; 0 disables "
+                             "(--tree)")
     parser.add_argument("--chaos", action="store_true",
                         help="robustness profile: run a full federated "
                              "round over real HTTP with deterministic "
@@ -371,6 +420,78 @@ def _run_load(args) -> int:
     return 0 if ok else 1
 
 
+def _run_tree(args) -> int:
+    """--tree: the hierarchical-aggregation drill — a real multi-level
+    round over HTTP (sda_tpu/tree/round.py) plus the population-scale
+    simulator record (sda_tpu/tree/sim.py), as one JSON line. No
+    mesh/JAX involved: this profile exercises the planner, the relay
+    protocol and the lifecycle tree propagation, not the kernels."""
+    import tempfile
+
+    import numpy as np
+
+    from ..crypto import sodium
+    from ..tree import run_tree_round, simulate_population_round
+
+    if not sodium.available():
+        print("error: --tree needs libsodium (real-crypto federated round)",
+              file=sys.stderr)
+        return 1
+    # the real-crypto rung drills the protocol, not throughput: bit-exact
+    # evidence needs a handful of groups, not a population (the attached
+    # simulator record is the population-scale half)
+    participants = min(args.participants, 48)
+    dim = min(args.dim, 16)
+    if (participants, dim) != (args.participants, args.dim):
+        print(f"note: --tree drills the hierarchy, not scale; clamping to "
+              f"--participants {participants} --dim {dim} (the simulated "
+              f"record covers --tree-sim {args.tree_sim})", file=sys.stderr)
+    modulus = 433  # the drill committees' ring (chaos/drill.py)
+    rng = np.random.default_rng(args.tree_seed)
+    inputs = rng.integers(0, modulus, size=(participants, dim),
+                          dtype=np.int64)
+    with tempfile.TemporaryDirectory() as tmp:
+        report = run_tree_round(
+            inputs,
+            group_size=args.tree_group_size,
+            fanout=args.tree_fanout,
+            modulus=modulus,
+            sharing=args.tree_sharing,
+            masking=args.tree_mask,
+            store=args.tree_store,
+            store_path=(None if args.tree_store == "memory"
+                        else f"{tmp}/store"),
+            http=True,
+            seed=args.tree_seed,
+            dropout_rate=args.tree_dropout,
+            dead_clerks_leaf=args.tree_dead_clerks,
+            flat_reference=True,
+        )
+    if args.tree_sim:
+        report["sim"] = simulate_population_round(
+            args.tree_sim, seed=args.tree_seed)
+    _export_trace(args, report)
+    print(json.dumps(report))
+    if args.tree_dead_clerks and args.tree_sharing == "additive":
+        # a failed leaf must fail the ROOT with a machine-readable
+        # reason naming the leaf — deterministically, not by hanging
+        ok = (report["root_state"] == "failed"
+              and report.get("failure") is not None
+              and "child round" in (report.get("root_reason") or ""))
+    elif args.tree_dead_clerks:
+        # packed: the leaf degrades, survivors feed up, root bit-exact
+        states = [s.get("state") for s in report["node_states"].values()]
+        ok = (bool(report["exact"]) and bool(report.get("flat_exact"))
+              and "degraded" in states
+              and report["root_state"] == "revealed")
+    else:
+        ok = bool(report["exact"]) and bool(report.get("flat_exact"))
+    if args.tree_sim:
+        ok = ok and bool(report["sim"]["exact"]) \
+            and bool(report["sim"]["bounded"])
+    return 0 if ok else 1
+
+
 def _run_chaos(args) -> int:
     """--chaos: the robustness drill — a full federated round over real
     HTTP under deterministic fault injection (sda_tpu/chaos/drill.py),
@@ -455,6 +576,8 @@ def main(argv=None) -> int:
 
     if args.load:
         return _run_load(args)
+    if args.tree:
+        return _run_tree(args)
     if args.chaos:
         return _run_chaos(args)
 

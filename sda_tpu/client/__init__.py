@@ -356,8 +356,12 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
 
         recipient_encryption = None
         if len(recipient_mask) > 0:
+            # flat rounds: the aggregation's recipient; tree rounds: the
+            # ROOT recipient, sealing the mask past the leaf's relay
+            # (the single rule lives on the resource — docs/scaling.md)
+            mask_owner, mask_key_id = aggregation.mask_seal_target()
             recipient_key = self._cached_verified_key(
-                aggregation_id, aggregation.recipient, aggregation.recipient_key
+                aggregation_id, mask_owner, mask_key_id
             )
             encryptor = self.crypto.new_share_encryptor(
                 recipient_key, aggregation.recipient_encryption_scheme
@@ -901,3 +905,4 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
 SdaParticipant = SdaClient
 
 from .journal import ParticipationJournal  # noqa: E402  (re-export)
+from . import relay  # noqa: E402  (the tree-round relay role; docs/scaling.md)

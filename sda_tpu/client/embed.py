@@ -152,8 +152,11 @@ def new_participation_embedded(
 
     recipient_pk = b""
     if kind != "none":
+        # flat rounds: the recipient; tree rounds: the ROOT recipient,
+        # past the leaf's relay — the one rule both clients share
+        mask_owner, mask_key_id = aggregation.mask_seal_target()
         recipient_pk = _sodium_pk(client._fetch_verified_key(
-            aggregation.recipient, aggregation.recipient_key))
+            mask_owner, mask_key_id))
     clerk_ids, clerk_pks = [], []
     for clerk_id, clerk_key_id in committee.clerks_and_keys:
         clerk_ids.append(clerk_id)

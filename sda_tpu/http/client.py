@@ -527,8 +527,13 @@ class SdaHttpClient(SdaService):
         )
 
     def create_participation(self, caller, participation):
+        # tree-relay participations (forwarded leaf-mask ciphertexts in
+        # band) ride the JSON wire: the v1 binary frame has no slot for
+        # them and bincodec.encode_participation refuses to drop them
+        resource = (None if participation.forwarded_masks is not None
+                    else participation)
         if self._post(caller, "/v1/aggregations/participations",
-                      participation.to_obj, resource=participation) is None:
+                      participation.to_obj, resource=resource) is None:
             # X-Resource-Not-Found 404: the aggregation is gone. The
             # in-process seam raises here, and resume() relies on the
             # distinction to reap orphaned journal entries instead of

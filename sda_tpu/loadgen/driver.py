@@ -131,7 +131,6 @@ def run_load(profile: LoadProfile) -> dict:
         Aggregation,
         AggregationId,
         FullMasking,
-        PackedShamirSharing,
         SodiumEncryption,
     )
     from ..server import new_jsonfs_server, new_memory_server, new_sqlite_server
@@ -141,13 +140,13 @@ def run_load(profile: LoadProfile) -> dict:
     if profile.arrivals not in ("open", "closed"):
         raise ValueError(f"unknown arrivals model {profile.arrivals!r}")
 
-    # the golden 8-clerk packed-Shamir committee (same as the chaos drill):
-    # crypto real, parameters small — the object under test is the
-    # transport/store plane, not the field arithmetic
-    scheme = PackedShamirSharing(
-        secret_count=3, share_count=8, privacy_threshold=4,
-        prime_modulus=433, omega_secrets=354, omega_shares=150,
-    )
+    # the golden 8-clerk packed-Shamir committee (ONE definition shared
+    # with the chaos and tree drills): crypto real, parameters small —
+    # the object under test is the transport/store plane, not the field
+    # arithmetic
+    from ..chaos.drill import golden_packed_scheme
+
+    scheme = golden_packed_scheme()
 
     obs.reset_all()
     chaos.reset()

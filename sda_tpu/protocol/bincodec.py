@@ -166,6 +166,13 @@ def _check_header(raw: bytes, want_tag: Optional[int] = None) -> int:
 
 
 def encode_participation(p: Participation) -> bytes:
+    if p.forwarded_masks is not None:
+        # tree-relay participations carry forwarded mask ciphertexts the
+        # v1 frame has no slot for; encoding would silently DROP them and
+        # corrupt the root's unmask. The HTTP client falls back to JSON
+        # for these (rare: one per leaf group per round).
+        raise ValueError(
+            "x-sda-bin v1 cannot frame forwarded_masks; use JSON")
     out: List[bytes] = [_header(TAG_PARTICIPATION)]
     _w_uuid(out, p.id)
     _w_uuid(out, p.participant)

@@ -144,8 +144,103 @@ def signed_encryption_key_from_obj(obj) -> Signed:
     )
 
 
+class TreeLink:
+    """Position of an aggregation inside a hierarchical (tree) round.
+
+    Flat committees cap out structurally — every clerk touches every
+    participation — so population-scale rounds shard the population into
+    leaf groups whose committees feed a parent round (``sda_tpu/tree``;
+    Bonawitz et al., MLSys 2019). This resource is the linkage that makes
+    the topology first-class on the wire:
+
+    - ``root``: the aggregation at the top of the tree (the one whose
+      recipient learns the final aggregate);
+    - ``parent``: the immediate parent aggregation this node's *relay*
+      re-shares its masked total into (``None`` on the root itself);
+    - ``children``: the child aggregations feeding this node (empty on
+      leaves) — recorded at plan time so any worker can walk the tree
+      from the round documents alone;
+    - ``level``: 0 at the root, increasing towards the leaves;
+    - ``group``: the leaf-group index assigned by the routing ring
+      (``server/routing.py``), ``None`` for internal nodes;
+    - ``mask_recipient`` / ``mask_recipient_key``: where participants
+      seal their recipient-mask ciphertexts. In a tree these name the
+      ROOT recipient, not the node's own recipient (the relay): the
+      relay quorum-reconstructs only the *masked* leaf total and
+      forwards the mask ciphertexts upward unopened, so privacy composes
+      per level — no relay ever sees an unmasked value.
+    """
+
+    __slots__ = ("root", "parent", "children", "level", "group",
+                 "mask_recipient", "mask_recipient_key")
+
+    def __init__(
+        self,
+        root: AggregationId,
+        parent: Optional[AggregationId] = None,
+        children: Optional[List[AggregationId]] = None,
+        level: int = 0,
+        group: Optional[int] = None,
+        mask_recipient: Optional[AgentId] = None,
+        mask_recipient_key: Optional[EncryptionKeyId] = None,
+    ):
+        self.root = root
+        self.parent = parent
+        self.children = list(children or [])
+        self.level = int(level)
+        self.group = None if group is None else int(group)
+        self.mask_recipient = mask_recipient
+        self.mask_recipient_key = mask_recipient_key
+
+    def __eq__(self, other):
+        return isinstance(other, TreeLink) and self.to_obj() == other.to_obj()
+
+    def __repr__(self):
+        return (f"TreeLink(root={self.root!r}, parent={self.parent!r}, "
+                f"level={self.level}, group={self.group})")
+
+    def to_obj(self):
+        return {
+            "root": self.root.to_obj(),
+            "parent": None if self.parent is None else self.parent.to_obj(),
+            "children": [c.to_obj() for c in self.children],
+            "level": self.level,
+            "group": self.group,
+            "mask_recipient": (
+                None if self.mask_recipient is None
+                else self.mask_recipient.to_obj()),
+            "mask_recipient_key": (
+                None if self.mask_recipient_key is None
+                else self.mask_recipient_key.to_obj()),
+        }
+
+    @classmethod
+    def from_obj(cls, obj):
+        parent = obj.get("parent")
+        mask_recipient = obj.get("mask_recipient")
+        mask_key = obj.get("mask_recipient_key")
+        return cls(
+            root=AggregationId.from_obj(obj["root"]),
+            parent=None if parent is None else AggregationId.from_obj(parent),
+            children=[AggregationId.from_obj(c)
+                      for c in (obj.get("children") or [])],
+            level=obj.get("level") or 0,
+            group=obj.get("group"),
+            mask_recipient=(None if mask_recipient is None
+                            else AgentId.from_obj(mask_recipient)),
+            mask_recipient_key=(None if mask_key is None
+                                else EncryptionKeyId.from_obj(mask_key)),
+        )
+
+
 class Aggregation:
-    """Description of an aggregation: dimensions, modulus, schemes, recipient."""
+    """Description of an aggregation: dimensions, modulus, schemes, recipient.
+
+    ``tree`` places the aggregation inside a hierarchical round
+    (:class:`TreeLink`); ``None`` — the default, and the only shape the
+    reference knows — means an ordinary flat round. The field is omitted
+    from the serialized object when absent, so flat aggregations keep the
+    exact reference wire shape."""
 
     __slots__ = (
         "id",
@@ -158,6 +253,7 @@ class Aggregation:
         "committee_sharing_scheme",
         "recipient_encryption_scheme",
         "committee_encryption_scheme",
+        "tree",
     )
 
     def __init__(
@@ -172,6 +268,7 @@ class Aggregation:
         committee_sharing_scheme: LinearSecretSharingScheme,
         recipient_encryption_scheme: AdditiveEncryptionScheme,
         committee_encryption_scheme: AdditiveEncryptionScheme,
+        tree: Optional[TreeLink] = None,
     ):
         self.id = id
         self.title = title
@@ -183,6 +280,7 @@ class Aggregation:
         self.committee_sharing_scheme = committee_sharing_scheme
         self.recipient_encryption_scheme = recipient_encryption_scheme
         self.committee_encryption_scheme = committee_encryption_scheme
+        self.tree = tree
 
     def __eq__(self, other):
         return isinstance(other, Aggregation) and self.to_obj() == other.to_obj()
@@ -196,8 +294,21 @@ class Aggregation:
         fields.update(kwargs)
         return Aggregation(**fields)
 
+    def mask_seal_target(self):
+        """``(owner AgentId, EncryptionKeyId)`` the recipient-MASK
+        ciphertext must seal to. Flat rounds: the aggregation's own
+        recipient. Tree rounds redirect to the ROOT recipient
+        (``TreeLink.mask_recipient_key``) — the node's own recipient is
+        a relay that must reconstruct only the masked total, and sealing
+        the mask past it is what makes privacy compose per level. THE
+        single rule for every participant implementation (Python client
+        and embedded client both call this)."""
+        if self.tree is not None and self.tree.mask_recipient_key is not None:
+            return self.tree.mask_recipient, self.tree.mask_recipient_key
+        return self.recipient, self.recipient_key
+
     def to_obj(self):
-        return {
+        obj = {
             "id": self.id.to_obj(),
             "title": self.title,
             "vector_dimension": self.vector_dimension,
@@ -209,9 +320,13 @@ class Aggregation:
             "recipient_encryption_scheme": self.recipient_encryption_scheme.to_obj(),
             "committee_encryption_scheme": self.committee_encryption_scheme.to_obj(),
         }
+        if self.tree is not None:
+            obj["tree"] = self.tree.to_obj()
+        return obj
 
     @classmethod
     def from_obj(cls, obj):
+        tree = obj.get("tree")
         return cls(
             id=AggregationId.from_obj(obj["id"]),
             title=obj["title"],
@@ -229,6 +344,7 @@ class Aggregation:
             committee_encryption_scheme=AdditiveEncryptionScheme.from_obj(
                 obj["committee_encryption_scheme"]
             ),
+            tree=None if tree is None else TreeLink.from_obj(tree),
         )
 
 
@@ -291,9 +407,19 @@ class Participation:
 
     The fresh ``id`` lets the server dedupe retried uploads
     (resources.rs:93-101).
+
+    ``forwarded_masks`` is the tree-aggregation extension: a *relay*
+    re-sharing its leaf's masked total into a parent round attaches the
+    leaf's recipient-mask ciphertexts (sealed to the ROOT recipient,
+    which the relay cannot open) so they travel upward IN-BAND with the
+    re-share — one exactly-once ingest covers both, and the parent's
+    snapshot mask collection picks them up alongside the relay's own
+    mask. ``None`` (the default) keeps the exact reference wire shape
+    and canonical digest for ordinary participations.
     """
 
-    __slots__ = ("id", "participant", "aggregation", "recipient_encryption", "clerk_encryptions")
+    __slots__ = ("id", "participant", "aggregation", "recipient_encryption",
+                 "clerk_encryptions", "forwarded_masks")
 
     def __init__(
         self,
@@ -302,12 +428,15 @@ class Participation:
         aggregation: AggregationId,
         recipient_encryption: Optional[Encryption],
         clerk_encryptions: List[Tuple[AgentId, Encryption]],
+        forwarded_masks: Optional[List[Encryption]] = None,
     ):
         self.id = id
         self.participant = participant
         self.aggregation = aggregation
         self.recipient_encryption = recipient_encryption
         self.clerk_encryptions = [(a, e) for (a, e) in clerk_encryptions]
+        self.forwarded_masks = (
+            None if forwarded_masks is None else list(forwarded_masks))
 
     def __eq__(self, other):
         return isinstance(other, Participation) and self.to_obj() == other.to_obj()
@@ -326,7 +455,7 @@ class Participation:
         return hashlib.sha256(canonical_json(self.to_obj())).hexdigest()
 
     def to_obj(self):
-        return {
+        obj = {
             "id": self.id.to_obj(),
             "participant": self.participant.to_obj(),
             "aggregation": self.aggregation.to_obj(),
@@ -337,10 +466,14 @@ class Participation:
                 [a.to_obj(), e.to_obj()] for (a, e) in self.clerk_encryptions
             ],
         }
+        if self.forwarded_masks is not None:
+            obj["forwarded_masks"] = [e.to_obj() for e in self.forwarded_masks]
+        return obj
 
     @classmethod
     def from_obj(cls, obj):
         rec = obj.get("recipient_encryption")
+        forwarded = obj.get("forwarded_masks")
         return cls(
             id=ParticipationId.from_obj(obj["id"]),
             participant=AgentId.from_obj(obj["participant"]),
@@ -350,6 +483,9 @@ class Participation:
                 (AgentId.from_obj(a), Encryption.from_obj(e))
                 for (a, e) in obj["clerk_encryptions"]
             ],
+            forwarded_masks=(
+                None if forwarded is None
+                else [Encryption.from_obj(e) for e in forwarded]),
         )
 
 
@@ -513,12 +649,17 @@ class RoundStatus:
     ``collecting → frozen → clerking → ready → revealed`` plus terminal
     ``degraded``/``failed``/``expired``). ``results`` is the LIVE
     clerking-result count; ``history`` is the bounded list of
-    ``[state, unix_ts]`` transition stamps."""
+    ``[state, unix_ts]`` transition stamps.
+
+    ``parent``/``children`` expose the hierarchical-round linkage
+    (:class:`TreeLink`): a stuck tree is diagnosable from any worker by
+    walking round documents — ``GET /v1/aggregations/{id}/round`` on the
+    root names its children, each child names its parent."""
 
     __slots__ = ("aggregation", "state", "snapshot", "scheme",
                  "committee_size", "reconstruction_threshold", "results",
                  "dead_clerks", "reason", "deadline_at", "updated_at",
-                 "history")
+                 "history", "parent", "children")
 
     def __init__(
         self,
@@ -534,6 +675,8 @@ class RoundStatus:
         deadline_at: Optional[float] = None,
         updated_at: Optional[float] = None,
         history=None,
+        parent: Optional[AggregationId] = None,
+        children=None,
     ):
         self.aggregation = aggregation
         self.state = str(state)
@@ -547,6 +690,8 @@ class RoundStatus:
         self.deadline_at = None if deadline_at is None else float(deadline_at)
         self.updated_at = None if updated_at is None else float(updated_at)
         self.history = [[str(s), float(ts)] for (s, ts) in (history or [])]
+        self.parent = None if parent is None else AggregationId(parent)
+        self.children = [AggregationId(c) for c in (children or [])]
 
     def __eq__(self, other):
         return isinstance(other, RoundStatus) and self.to_obj() == other.to_obj()
@@ -569,6 +714,8 @@ class RoundStatus:
             "deadline_at": self.deadline_at,
             "updated_at": self.updated_at,
             "history": [[s, ts] for (s, ts) in self.history],
+            "parent": None if self.parent is None else self.parent.to_obj(),
+            "children": [c.to_obj() for c in self.children],
         }
 
     @classmethod
@@ -587,6 +734,8 @@ class RoundStatus:
             deadline_at=obj.get("deadline_at"),
             updated_at=obj.get("updated_at"),
             history=obj.get("history") or [],
+            parent=obj.get("parent"),
+            children=obj.get("children") or [],
         )
 
 

@@ -236,6 +236,8 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
             for sid in self.list_snapshots(aggregation):
                 (self.root / "snapshot_parts" / f"{sid}.json").unlink(missing_ok=True)
                 (self.root / "masks" / f"{sid}.json").unlink(missing_ok=True)
+                shutil.rmtree(self.root / "masks" / str(sid),
+                              ignore_errors=True)
             for sub in ("participations", "part_owners", "snapshots"):
                 shutil.rmtree(self.root / sub / str(aggregation), ignore_errors=True)
             (self.root / "aggregations" / f"{aggregation}.json").unlink(missing_ok=True)
@@ -365,21 +367,38 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
                     out.append(Participation.from_obj(obj))
             return out
 
+    def _iter_snapped_docs(self, aggregation, snapshot):
+        """Streamed walk of the frozen set's documents: the id list is
+        read once under the lock (small), then one document file is
+        resident at a time — O(1) documents in memory at tree-scale
+        counts, with the lock released between files so the snapshot
+        pipeline's interleaved mask-chunk writes never queue behind a
+        full-set scan."""
+        with self._lock:
+            part_ids = _read_json(
+                self.root / "snapshot_parts" / f"{snapshot}.json") or []
+        for pid in part_ids:
+            with self._lock:
+                obj = _read_json(
+                    self.root / "participations" / str(aggregation)
+                    / f"{pid}.json"
+                )
+            if obj is not None:
+                yield obj
+
     def iter_snapped_recipient_encryptions(self, aggregation, snapshot):
         # mask-column read: decode only the recipient_encryption field of
         # each frozen document instead of re-materializing every
         # participation a second time
-        with self._lock:
-            part_ids = _read_json(self.root / "snapshot_parts" / f"{snapshot}.json") or []
-            out = []
-            for pid in part_ids:
-                obj = _read_json(
-                    self.root / "participations" / str(aggregation) / f"{pid}.json"
-                )
-                if obj is not None:
-                    enc = obj.get("recipient_encryption")
-                    out.append(None if enc is None else Encryption.from_obj(enc))
-            return out
+        for obj in self._iter_snapped_docs(aggregation, snapshot):
+            enc = obj.get("recipient_encryption")
+            yield None if enc is None else Encryption.from_obj(enc)
+
+    def iter_snapped_forwarded_masks(self, aggregation, snapshot):
+        # forwarded-mask column read (tree parents): same streamed walk
+        for obj in self._iter_snapped_docs(aggregation, snapshot):
+            for enc in obj.get("forwarded_masks") or ():
+                yield Encryption.from_obj(enc)
 
     # -- round lifecycle ----------------------------------------------------
     def put_round_state(self, doc):
@@ -413,13 +432,46 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
             return True
 
     def create_snapshot_mask(self, snapshot, mask):
+        self.put_snapshot_mask_chunk(snapshot, 0, mask)
+        self.trim_snapshot_mask_chunks(snapshot, 1)
+
+    def put_snapshot_mask_chunk(self, snapshot, index, encryptions):
+        # one file per chunk under masks/<snapshot>/, pure upsert: file
+        # writes are atomic (temp+replace) and a replaying or contended
+        # pipeline rewrites byte-identical chunks (stores.py contract),
+        # so readers always see a complete mask. Chunk 0 supersedes any
+        # legacy single-file mask.
         with self._lock:
-            _write_json(
-                self.root / "masks" / f"{snapshot}.json", [e.to_obj() for e in mask]
-            )
+            directory = self.root / "masks" / str(snapshot)
+            if index == 0:
+                (self.root / "masks" / f"{snapshot}.json").unlink(
+                    missing_ok=True)
+            directory.mkdir(parents=True, exist_ok=True)
+            _write_json(directory / f"{int(index):08d}.json",
+                        [e.to_obj() for e in encryptions])
+
+    def trim_snapshot_mask_chunks(self, snapshot, count):
+        with self._lock:
+            directory = self.root / "masks" / str(snapshot)
+            if not directory.is_dir():
+                return
+            for path in directory.glob("*.json"):
+                try:
+                    if int(path.stem) >= int(count):
+                        path.unlink(missing_ok=True)
+                except ValueError:
+                    continue  # not a chunk file
 
     def get_snapshot_mask(self, snapshot):
         with self._lock:
+            directory = self.root / "masks" / str(snapshot)
+            if directory.is_dir():
+                out = []
+                for path in sorted(directory.glob("*.json")):
+                    out.extend(Encryption.from_obj(e)
+                               for e in _read_json(path) or [])
+                return out
+            # pre-chunking layout: fall back to the legacy single file
             obj = _read_json(self.root / "masks" / f"{snapshot}.json")
             return None if obj is None else [Encryption.from_obj(e) for e in obj]
 

@@ -105,12 +105,16 @@ def new_round_doc(aggregation, deadlines: Optional[RoundDeadlines]) -> dict:
     """Fresh ``collecting`` record for a just-created aggregation. The
     scheme facts the sweeper needs later (kind, committee size,
     reconstruction threshold) are denormalized in so a sweep never has to
-    re-parse the aggregation resource."""
+    re-parse the aggregation resource — and so is the tree linkage
+    (``parent``/``children``/``level``, from ``Aggregation.tree``): the
+    sweeper's leaf-failure propagation and the ``/statusz`` tree view
+    walk round documents alone, never the aggregation resources."""
     scheme = aggregation.committee_sharing_scheme
     now = time.time()
     deadline = None
     if deadlines is not None and deadlines.collecting_s:
         deadline = now + deadlines.collecting_s
+    tree = getattr(aggregation, "tree", None)
     return {
         "aggregation": str(aggregation.id),
         "state": "collecting",
@@ -123,6 +127,12 @@ def new_round_doc(aggregation, deadlines: Optional[RoundDeadlines]) -> dict:
         "deadline_at": deadline,
         "updated_at": now,
         "history": [["collecting", round(now, 3)]],
+        "parent": (str(tree.parent)
+                   if tree is not None and tree.parent is not None else None),
+        "children": ([str(c) for c in tree.children]
+                     if tree is not None else []),
+        "level": (int(tree.level) if tree is not None else None),
+        "group": (tree.group if tree is not None else None),
     }
 
 
@@ -277,6 +287,8 @@ def round_status(server, aggregation_id) -> Optional[RoundStatus]:
         deadline_at=doc.get("deadline_at"),
         updated_at=doc.get("updated_at"),
         history=doc.get("history") or [],
+        parent=doc.get("parent"),
+        children=doc.get("children") or [],
     )
 
 
@@ -302,6 +314,12 @@ def rounds_report(server, limit: int = 16) -> dict:
                 "reason": d.get("reason"),
                 "dead_clerks": d.get("dead_clerks") or None,
                 "updated_at": d.get("updated_at"),
+                # tree linkage: a stuck hierarchical round is diagnosable
+                # from ANY worker's /statusz — the root row names its
+                # children, each leaf row names its parent and level
+                "parent": d.get("parent"),
+                "children": d.get("children") or None,
+                "level": d.get("level"),
             }
             for d in recent
         ],
@@ -393,12 +411,74 @@ class RoundSweeper:
                     continue  # ready waits on the recipient, not on us
                 action = self._sweep_round(doc, now)
                 if action is not None:
+                    # fold the verdict into OUR listing too: the tree
+                    # pass below reads these docs, and the store write
+                    # inside transition() doesn't update them
+                    doc["state"] = action["to"]
+                    if action.get("reason") is not None:
+                        doc["reason"] = action["reason"]
+                    if action.get("dead_clerks") is not None:
+                        doc["dead_clerks"] = action["dead_clerks"]
                     actions.append(action)
                     obs.add_event("round.sweep_action", **action)
+            # tree propagation AFTER per-round diagnosis: a leaf the pass
+            # above just declared failed/expired fails its ancestors in
+            # the SAME sweep (no extra tick of latency)
+            actions.extend(self._sweep_tree(docs))
             sweep_span.set_attribute("rounds", len(docs))
             sweep_span.set_attribute("actions", len(actions))
         metrics.observe("server.round.sweep", time.perf_counter() - t0)
         return {"rounds": len(docs), "actions": actions}
+
+    # -- tree propagation ---------------------------------------------------
+    def _sweep_tree(self, docs: List[dict]) -> List[dict]:
+        """Hierarchical-round failure propagation (``sda_tpu/tree``).
+
+        A leaf that went ``degraded`` needs no propagation — its relay
+        completes from the surviving quorum and feeds the parent round
+        normally. But a leaf that reached a DEAD terminal state
+        (``failed``/``expired``) can never produce its partial aggregate,
+        so every ancestor is unrecoverable: fail the parent round with a
+        machine-readable reason NAMING the leaf, instead of letting the
+        root hang until its own deadline with no diagnosis. CAS
+        transitions keep this exactly-once across a sweeping fleet, and
+        re-listing is unnecessary — a parent failed here is seen by its
+        own parent on the next sweep tick (one tick per tree level)."""
+        by_id = {d.get("aggregation"): d for d in docs}
+        actions: List[dict] = []
+        for doc in docs:
+            state = doc.get("state")
+            if state in TERMINAL_STATES or not doc.get("children"):
+                continue
+            for child_id in doc["children"]:
+                child = by_id.get(str(child_id))
+                if child is None or child.get("state") not in ("failed",
+                                                               "expired"):
+                    continue
+                where = ""
+                if child.get("level") is not None:
+                    where = (f" (level {child['level']}"
+                             + (f", group {child['group']}"
+                                if child.get("group") is not None else "")
+                             + ")")
+                reason = (
+                    f"child round {child_id}{where} is {child['state']}: "
+                    f"{child.get('reason') or 'no reason recorded'}")
+                aggregation = AggregationId(doc["aggregation"])
+                if transition(self.server.aggregation_store, aggregation,
+                              (state,), "failed", reason=reason,
+                              dead_clerks=child.get("dead_clerks") or None):
+                    # fold into our listing: an ancestor later in this
+                    # same pass sees the propagated failure immediately
+                    doc["state"] = "failed"
+                    doc["reason"] = reason
+                    metrics.count("server.round.tree_failed")
+                    log.warning("round %s -> failed (tree): %s",
+                                aggregation, reason)
+                    actions.append({"aggregation": str(aggregation),
+                                    "to": "failed", "reason": reason})
+                break  # one verdict per parent per sweep is enough
+        return actions
 
     # -- per-round diagnosis ------------------------------------------------
     def _sweep_round(self, doc: dict, now: float) -> Optional[dict]:

@@ -272,12 +272,29 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
 
     def create_snapshot_mask(self, snapshot, mask):
         with self._lock:
-            self._snapshot_masks[snapshot] = list(mask)
+            self._snapshot_masks[snapshot] = {0: list(mask)}
+
+    def put_snapshot_mask_chunk(self, snapshot, index, encryptions):
+        # pure chunk upsert keyed by index: replays/contended peers
+        # rewrite identical chunks, so readers always see a complete
+        # mask (stores.py contract); trim drops any excess at the end
+        with self._lock:
+            chunks = self._snapshot_masks.setdefault(snapshot, {})
+            chunks[int(index)] = list(encryptions)
+
+    def trim_snapshot_mask_chunks(self, snapshot, count):
+        with self._lock:
+            chunks = self._snapshot_masks.get(snapshot)
+            if chunks is not None:
+                for ix in [ix for ix in chunks if ix >= int(count)]:
+                    del chunks[ix]
 
     def get_snapshot_mask(self, snapshot):
         with self._lock:
-            mask = self._snapshot_masks.get(snapshot)
-            return None if mask is None else list(mask)
+            chunks = self._snapshot_masks.get(snapshot)
+            if chunks is None:
+                return None
+            return [e for ix in sorted(chunks) for e in chunks[ix]]
 
 
 class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):

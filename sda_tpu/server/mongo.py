@@ -174,6 +174,8 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         snap_ids = [d["_id"] for d in self.db.snapshots.find({"aggregation": agg})]
         if snap_ids:
             self.db.snapshot_masks.delete_many({"_id": {"$in": snap_ids}})
+            self.db.snapshot_mask_chunks.delete_many(
+                {"snapshot": {"$in": snap_ids}})
             self.db.snapshot_freezes.delete_many({"_id": {"$in": snap_ids}})
         self.db.participations.delete_many({"aggregation": agg})
         self.db.participation_owners.delete_many(
@@ -370,6 +372,33 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
             Participation.from_obj(d["doc"]) for d in cursor.sort("_id", 1)
         ]
 
+    def _iter_snapped_docs(self, aggregation, snapshot):
+        """Streamed walk of the frozen set's documents (cursor-batched by
+        the driver — O(batch) resident, never the whole population)."""
+        ids = self._frozen_ids(snapshot)
+        if ids is not None:
+            cursor = self.db.participations.find(
+                {"aggregation": str(aggregation), "_id": {"$in": ids}}
+            )
+        else:  # legacy $addToSet freeze
+            cursor = self.db.participations.find(
+                {"aggregation": str(aggregation), "snapshots": str(snapshot)}
+            )
+        for d in cursor.sort("_id", 1):
+            yield d["doc"]
+
+    def iter_snapped_recipient_encryptions(self, aggregation, snapshot):
+        # mask-column read: decode only the recipient_encryption field
+        for doc in self._iter_snapped_docs(aggregation, snapshot):
+            enc = doc.get("recipient_encryption")
+            yield None if enc is None else Encryption.from_obj(enc)
+
+    def iter_snapped_forwarded_masks(self, aggregation, snapshot):
+        # forwarded-mask column read (tree parents): same streamed walk
+        for doc in self._iter_snapped_docs(aggregation, snapshot):
+            for enc in doc.get("forwarded_masks") or ():
+                yield Encryption.from_obj(enc)
+
     # -- round lifecycle ----------------------------------------------------
     def put_round_state(self, doc):
         self.db.rounds.replace_one(
@@ -395,13 +424,35 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         return found is not None
 
     def create_snapshot_mask(self, snapshot, mask):
-        self.db.snapshot_masks.replace_one(
-            {"_id": str(snapshot)},
-            {"_id": str(snapshot), "doc": [e.to_obj() for e in mask]},
+        self.put_snapshot_mask_chunk(snapshot, 0, mask)
+        self.trim_snapshot_mask_chunks(snapshot, 1)
+
+    def put_snapshot_mask_chunk(self, snapshot, index, encryptions):
+        # one document per chunk, _id "<snapshot>:<ix>", pure upsert: a
+        # replaying or contended pipeline rewrites byte-identical chunks
+        # (stores.py contract), so readers always see a complete mask.
+        # Chunk 0 supersedes any legacy single-document mask.
+        snap = str(snapshot)
+        if index == 0:
+            self.db.snapshot_masks.delete_many({"_id": snap})
+        self.db.snapshot_mask_chunks.replace_one(
+            {"_id": f"{snap}:{int(index):08d}"},
+            {"_id": f"{snap}:{int(index):08d}", "snapshot": snap,
+             "chunk_ix": int(index), "doc": [e.to_obj() for e in encryptions]},
             upsert=True,
         )
 
+    def trim_snapshot_mask_chunks(self, snapshot, count):
+        self.db.snapshot_mask_chunks.delete_many(
+            {"snapshot": str(snapshot), "chunk_ix": {"$gte": int(count)}})
+
     def get_snapshot_mask(self, snapshot):
+        chunks = list(self.db.snapshot_mask_chunks.find(
+            {"snapshot": str(snapshot)}))
+        if chunks:
+            chunks.sort(key=lambda d: d.get("chunk_ix", 0))
+            return [Encryption.from_obj(e) for c in chunks for e in c["doc"]]
+        # pre-chunking database: fall back to the legacy single document
         doc = self.db.snapshot_masks.find_one({"_id": str(snapshot)})
         if doc is None:
             return None

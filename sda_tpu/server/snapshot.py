@@ -84,6 +84,86 @@ def _premix_columns(server, aggregation, committee, columns):
     return mixed
 
 
+#: Upper bound on mask-ciphertext chunks materialized in pipeline memory
+#: at once (override via SDA_SNAPSHOT_MASK_BATCH). Tree-scale leaf counts
+#: make the mask column the largest per-round allocation on the broker;
+#: chunking keeps snapshot memory O(batch) regardless of population size.
+#: FLEET-UNIFORM, like every per-worker protocol knob: concurrent
+#: pipelines chunking ONE snapshot at different boundaries cannot
+#: converge (stores.py mask-chunk contract) — never vary this across
+#: workers of one fleet mid-flight; the trim step only reconciles
+#: SEQUENTIAL config changes (a replay after restart).
+DEFAULT_MASK_BATCH = 1024
+
+
+def _mask_batch_size() -> int:
+    import os
+
+    raw = os.environ.get("SDA_SNAPSHOT_MASK_BATCH", "")
+    try:
+        return max(1, int(raw)) if raw.strip() else DEFAULT_MASK_BATCH
+    except ValueError:
+        return DEFAULT_MASK_BATCH
+
+
+def _collect_masks_streamed(server, aggregation, snap) -> None:
+    """Stream the recipient-mask column into bounded store chunks.
+
+    The column read stays a per-participation iterator and each full
+    batch is flushed with ``put_snapshot_mask_chunk`` — pipeline memory
+    is O(batch), not O(participants). Chunk writes are pure upserts: a
+    crash-replay (or a contended fleet peer re-running the pipeline over
+    the SAME frozen set) rewrites an identical chunk sequence, so any
+    interleaving converges bit-exactly AND a reader holding the
+    committed snapshot record always sees a complete mask (stores.py
+    contended-idempotency contract); the final trim drops excess chunks
+    left by an attempt that used a different batch size.
+
+    Tree parents additionally append the frozen set's FORWARDED mask
+    ciphertexts (``Participation.forwarded_masks`` — each relay's leaf
+    masks, sealed to the root recipient), so the root's reveal sees one
+    flat mask list: relay masks first (participation order), then the
+    forwarded leaf masks.
+    """
+    batch = _mask_batch_size()
+    store = server.aggregation_store
+    chunk, index, total = [], 0, 0
+
+    def flush():
+        nonlocal chunk, index
+        store.put_snapshot_mask_chunk(snap.id, index, chunk)
+        metrics.observe("server.snapshot.mask_chunk", len(chunk))
+        index += 1
+        chunk = []
+
+    for encryption in store.iter_snapped_recipient_encryptions(
+        snap.aggregation, snap.id
+    ):
+        if encryption is None:
+            raise NotFound("participation should have had a recipient encryption")
+        chunk.append(encryption)
+        total += 1
+        if len(chunk) >= batch:
+            flush()
+    tree = getattr(aggregation, "tree", None)
+    if tree is not None and tree.children:
+        # forwarded leaf masks ride the SAME chunked stream upward
+        for encryption in store.iter_snapped_forwarded_masks(
+            snap.aggregation, snap.id
+        ):
+            chunk.append(encryption)
+            total += 1
+            if len(chunk) >= batch:
+                flush()
+    # always write the final (possibly empty) chunk: chunk 0 must exist so
+    # get_snapshot_mask distinguishes "masked round, zero participations"
+    # from "never collected"
+    if chunk or index == 0:
+        flush()
+    store.trim_snapshot_mask_chunks(snap.id, index)
+    metrics.count("server.snapshot.masks_collected", total)
+
+
 def snapshot(server, snap: Snapshot) -> bool:
     # the whole pipeline is serialized: a timed-out client retry arriving
     # while the original is still running must wait and then hit the
@@ -169,16 +249,8 @@ def _snapshot_locked(server, snap: Snapshot) -> bool:
 
     if aggregation.masking_scheme.has_mask:
         log.debug("snapshot %s: collecting recipient mask encryptions", snap.id)
-        # column read: only the recipient_encryption field of each frozen
-        # document, not a second full-participation materialization
-        recipient_encryptions = []
-        for encryption in server.aggregation_store.iter_snapped_recipient_encryptions(
-            snap.aggregation, snap.id
-        ):
-            if encryption is None:
-                raise NotFound("participation should have had a recipient encryption")
-            recipient_encryptions.append(encryption)
-        server.aggregation_store.create_snapshot_mask(snap.id, recipient_encryptions)
+        with timed_phase("server.collect_masks"):
+            _collect_masks_streamed(server, aggregation, snap)
 
     # the snapshot record is the commit point and therefore goes LAST:
     # its presence proves jobs and masks are durable, so the existence

@@ -96,6 +96,9 @@ CREATE TABLE IF NOT EXISTS snapshot_freezes (
     snapshot TEXT PRIMARY KEY);
 CREATE TABLE IF NOT EXISTS snapshot_masks (
     snapshot TEXT PRIMARY KEY, doc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS snapshot_mask_chunks (
+    snapshot TEXT NOT NULL, chunk_ix INTEGER NOT NULL, doc TEXT NOT NULL,
+    PRIMARY KEY (snapshot, chunk_ix));
 CREATE TABLE IF NOT EXISTS clerking_jobs (
     id TEXT NOT NULL, clerk TEXT NOT NULL, snapshot TEXT NOT NULL,
     done INTEGER NOT NULL DEFAULT 0, leased_until REAL NOT NULL DEFAULT 0,
@@ -339,7 +342,8 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
     def delete_aggregation(self, aggregation):
         agg = str(aggregation)
         with self.db.immediate():
-            for table in ("snapshot_parts", "snapshot_masks", "snapshot_freezes"):
+            for table in ("snapshot_parts", "snapshot_masks",
+                          "snapshot_mask_chunks", "snapshot_freezes"):
                 self.db.conn.execute(
                     f"DELETE FROM {table} WHERE snapshot IN "
                     "(SELECT id FROM snapshots WHERE aggregation = ?)",
@@ -529,20 +533,43 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
                 columns[ix].append(Encryption.from_obj(enc))
         return columns
 
+    #: rows per keyset page of the streamed mask-column reads below —
+    #: each page completes its statement before the caller's interleaved
+    #: chunk writes, so reader memory is O(page) at tree-scale counts
+    _MASK_PAGE = 256
+
+    def _iter_snapped_docs(self, aggregation, snapshot):
+        """Keyset-paginated walk of the frozen set's documents, in
+        participation-id order: only one page of JSON is ever resident,
+        and no cursor stays open across the mask-chunk writes the
+        snapshot pipeline interleaves with this read."""
+        last = ""
+        while True:
+            rows = self._all(
+                "SELECT p.id, p.doc FROM snapshot_parts s "
+                "JOIN participations p ON p.id = s.participation AND p.aggregation = ? "
+                "WHERE s.snapshot = ? AND p.id > ? ORDER BY p.id LIMIT ?",
+                (str(aggregation), str(snapshot), last, self._MASK_PAGE),
+            )
+            if not rows:
+                return
+            for _pid, doc in rows:
+                yield json.loads(doc)
+            last = rows[-1][0]
+
     def iter_snapped_recipient_encryptions(self, aggregation, snapshot):
-        # mask-column read: same single join, decode only the
-        # recipient_encryption field
-        rows = self._all(
-            "SELECT p.doc FROM snapshot_parts s "
-            "JOIN participations p ON p.id = s.participation AND p.aggregation = ? "
-            "WHERE s.snapshot = ? ORDER BY p.id",
-            (str(aggregation), str(snapshot)),
-        )
-        out = []
-        for (doc,) in rows:
-            enc = json.loads(doc).get("recipient_encryption")
-            out.append(None if enc is None else Encryption.from_obj(enc))
-        return out
+        # mask-column read: decode only the recipient_encryption field,
+        # streamed page by page
+        for doc in self._iter_snapped_docs(aggregation, snapshot):
+            enc = doc.get("recipient_encryption")
+            yield None if enc is None else Encryption.from_obj(enc)
+
+    def iter_snapped_forwarded_masks(self, aggregation, snapshot):
+        # forwarded-mask column read (tree parents): same streamed walk,
+        # decode only the forwarded_masks field
+        for doc in self._iter_snapped_docs(aggregation, snapshot):
+            for enc in doc.get("forwarded_masks") or ():
+                yield Encryption.from_obj(enc)
 
     # -- round lifecycle ----------------------------------------------------
     def put_round_state(self, doc):
@@ -577,19 +604,53 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
         return cursor.rowcount > 0
 
     def create_snapshot_mask(self, snapshot, mask):
+        self.put_snapshot_mask_chunk(snapshot, 0, mask)
+        self.trim_snapshot_mask_chunks(snapshot, 1)
+
+    def put_snapshot_mask_chunk(self, snapshot, index, encryptions):
+        # pure chunk upsert keyed by (snapshot, chunk_ix): a replaying or
+        # contended pipeline rewrites byte-identical chunks (stores.py
+        # contract), so a reader holding the committed snapshot record
+        # always sees a complete mask — the atomicity the old single-row
+        # write had. Chunk 0 also supersedes any legacy single-row mask.
+        snap = str(snapshot)
+        doc = json.dumps([e.to_obj() for e in encryptions])
+        with self.db.immediate():
+            if index == 0:
+                self.db.conn.execute(
+                    "DELETE FROM snapshot_masks WHERE snapshot = ?", (snap,))
+            self.db.conn.execute(
+                "INSERT INTO snapshot_mask_chunks (snapshot, chunk_ix, doc) "
+                "VALUES (?, ?, ?) ON CONFLICT (snapshot, chunk_ix) "
+                "DO UPDATE SET doc = excluded.doc",
+                (snap, int(index), doc),
+            )
+
+    def trim_snapshot_mask_chunks(self, snapshot, count):
         self._exec(
-            "INSERT INTO snapshot_masks (snapshot, doc) VALUES (?, ?) "
-            "ON CONFLICT (snapshot) DO UPDATE SET doc = excluded.doc",
-            (str(snapshot), json.dumps([e.to_obj() for e in mask])),
+            "DELETE FROM snapshot_mask_chunks WHERE snapshot = ? "
+            "AND chunk_ix >= ?", (str(snapshot), int(count)),
         )
 
     def get_snapshot_mask(self, snapshot):
-        row = self._one(
-            "SELECT doc FROM snapshot_masks WHERE snapshot = ?", (str(snapshot),)
+        rows = self._all(
+            "SELECT doc FROM snapshot_mask_chunks WHERE snapshot = ? "
+            "ORDER BY chunk_ix", (str(snapshot),)
         )
-        if row is None:
-            return None
-        return [Encryption.from_obj(e) for e in json.loads(row[0])]
+        if not rows:
+            # pre-chunking database: fall back to the legacy single row
+            row = self._one(
+                "SELECT doc FROM snapshot_masks WHERE snapshot = ?",
+                (str(snapshot),)
+            )
+            if row is None:
+                return None
+            return [Encryption.from_obj(e) for e in json.loads(row[0])]
+        return [
+            Encryption.from_obj(e)
+            for (doc,) in rows
+            for e in json.loads(doc)
+        ]
 
 
 class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):

@@ -212,10 +212,69 @@ class AggregationsStore(BaseStore):
             for p in self.iter_snapped_participations(aggregation, snapshot)
         ]
 
+    def iter_snapped_forwarded_masks(
+        self, aggregation: AggregationId, snapshot: SnapshotId
+    ) -> Iterable[Encryption]:
+        """Flattened ``forwarded_masks`` ciphertexts of the frozen set, in
+        participation order — the leaf-mask ciphertexts tree relays carry
+        upward in-band (``Participation.forwarded_masks``). Empty for
+        flat rounds; the snapshot pipeline only walks this for tree
+        parents, so the full-document fallback below costs nothing
+        elsewhere."""
+        for p in self.iter_snapped_participations(aggregation, snapshot):
+            for encryption in (p.forwarded_masks or ()):
+                yield encryption
+
     @abc.abstractmethod
     def create_snapshot_mask(
         self, snapshot: SnapshotId, mask: List[Encryption]
     ) -> None: ...
+
+    def put_snapshot_mask_chunk(
+        self, snapshot: SnapshotId, index: int, encryptions: List[Encryption]
+    ) -> None:
+        """Chunked snapshot-mask write — the O(batch) half of the
+        streamed mask collection (``server/snapshot.py``): the pipeline
+        writes the recipient-mask column as bounded chunks keyed by
+        ``(snapshot, chunk index)`` instead of materializing the whole
+        list in memory first. Contract:
+
+        - chunks are pure upserts keyed by index — NEVER a wipe. The
+          chunk stream is deterministic from the frozen set (single-
+          winner across the fleet) and the batch size, so a crash-replay
+          or a contended peer rewrites byte-identical chunks: any
+          interleaving converges bit-exactly, and a reader that already
+          holds the committed snapshot record always sees a COMPLETE
+          mask (the atomicity the old single-row write had). This
+          REQUIRES every fleet worker to chunk at the same batch size
+          (``SDA_SNAPSHOT_MASK_BATCH``) — like every per-worker protocol
+          knob (lease seconds, deadlines, premix), it must be uniform
+          across the fleet: writers chunking one snapshot at different
+          boundaries cannot converge under concurrency, trim or no trim;
+        - ``trim_snapshot_mask_chunks`` finishes the stream, dropping
+          chunks past the end (a leftover from an attempt chunked with a
+          different batch size);
+        - ``get_snapshot_mask`` returns the concatenation in index order.
+
+        The four in-repo backends override with durable chunk rows; this
+        read-modify-write fallback keeps third-party stores working (NOT
+        fleet-safe, like the round-state fallbacks above)."""
+        if index == 0:
+            self.create_snapshot_mask(snapshot, list(encryptions))
+            return
+        existing = self.get_snapshot_mask(snapshot) or []
+        self.create_snapshot_mask(snapshot, existing + list(encryptions))
+
+    def trim_snapshot_mask_chunks(
+        self, snapshot: SnapshotId, count: int
+    ) -> None:
+        """Drop mask chunks with index >= ``count`` — the end-of-stream
+        marker of the chunked mask write above. A no-op everywhere
+        except after an attempt that chunked the same snapshot with a
+        LARGER batch size (fewer chunks) than a crashed predecessor.
+        Backends with durable chunk rows override; the fallback's
+        whole-list writes never leave excess chunks."""
+        return None
 
     @abc.abstractmethod
     def get_snapshot_mask(self, snapshot: SnapshotId) -> Optional[List[Encryption]]: ...

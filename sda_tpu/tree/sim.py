@@ -1,0 +1,184 @@
+"""Population-scale tree simulation: the ``participants=1e5`` record.
+
+Running 10⁵ real sealed-box participations through HTTP is a throughput
+benchmark, not a planning check — what population scale actually stresses
+is the *shape* of the computation: does the planner shard 10⁵ devices
+deterministically, does the modular tree algebra reveal the exact flat
+sum, and does any single node ever have to materialize more than a
+bounded batch? This simulator answers exactly those questions with the
+real planner (ring sharding over 10⁵ keys) and the real tree algebra
+(mask, per-leaf masked totals mod m, relay reduction, root unmask) — it
+elides only the ciphertexts, whose per-item cost is already measured by
+the HTTP drills at small scale.
+
+Memory discipline mirrors the production pipeline
+(``server/snapshot.py``'s chunked mask collection): every per-leaf pass
+streams participant batches of ``batch`` rows, each batch's live arrays
+are counted against ``peak_node_elements``, and the drill ASSERTS the
+peak stays a function of the batch size, never of the population. Inputs
+and masks are regenerated per-batch from seeded counters, so the flat
+reference can re-walk the same population without holding it either.
+
+The returned record is BENCH-shaped (``metric``/``value``/``unit``) and
+rides the regression gate advisory in ci.sh via ``sda-bench --check``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from .plan import TreePlan, plan_tree
+
+#: Live arrays per streamed batch: inputs, masks, masked (x batch x dim).
+_ARRAYS_PER_BATCH = 3
+
+
+_KIND_TAGS = {"x": 1, "m": 2}
+
+
+def _batch_rng(seed: int, leaf_group: int, batch_ix: int, kind: str):
+    # SeedSequence is deterministic across processes (unlike str hash),
+    # which is what lets the flat reference re-walk the same population
+    return np.random.default_rng(np.random.SeedSequence(
+        [int(seed), int(leaf_group), int(batch_ix), _KIND_TAGS[kind]]))
+
+
+def simulate_population_round(
+    participants: int = 100_000,
+    *,
+    group_size: int = 4096,
+    fanout: Optional[int] = None,
+    dim: int = 8,
+    modulus: int = (1 << 31) - 1,
+    batch: int = 2048,
+    seed: int = 0,
+) -> dict:
+    """Simulate one fixed-seed tree round at population scale.
+
+    Returns the BENCH-style record with the verdicts the ci.sh drill
+    gates on: ``exact`` (tree total == flat total, bit-exact),
+    ``bounded`` (peak per-node elements never exceeded the streamed
+    bound), and ``value`` = simulated participants aggregated per second
+    (higher is better, advisory on CPU).
+    """
+    import tracemalloc
+
+    if participants < 1:
+        raise ValueError("need at least one participant")
+    t0 = time.perf_counter()
+    keys = [f"dev-{seed}-{ix}" for ix in range(participants)]
+    plan: TreePlan = plan_tree(keys, group_size=group_size, fanout=fanout,
+                               seed=f"sim-{seed}")
+    leaves = plan.leaves()
+
+    peak_node_elements = 0
+    bound_elements = _ARRAYS_PER_BATCH * batch * dim
+
+    def observe(*arrays) -> None:
+        nonlocal peak_node_elements
+        live = sum(int(a.size) for a in arrays)
+        if live > peak_node_elements:
+            peak_node_elements = live
+
+    # the bounded-memory verdict must be a MEASUREMENT, not an
+    # accounting identity: tracemalloc (numpy allocations route through
+    # it) watches the whole streaming pass below — planning, which
+    # legitimately holds the O(N) key list, stays outside the window.
+    # Any future change that materializes the population inside the
+    # pass blows the peak past the batch-derived bound and fails the
+    # drill, whatever observe() happens to count.
+    was_tracing = tracemalloc.is_tracing()
+    if not was_tracing:
+        tracemalloc.start()
+    baseline, _ = tracemalloc.get_traced_memory()
+    tracemalloc.reset_peak()
+
+    # -- leaf passes: masked totals (what each leaf committee + relay
+    # computes) and the root's mask total (what the forwarded ciphertexts
+    # decrypt to at the root) — accumulated in O(batch) memory per node
+    masked_leaf_totals = np.zeros((len(leaves), dim), dtype=np.int64)
+    root_mask_total = np.zeros(dim, dtype=np.int64)
+    flat_total = np.zeros(dim, dtype=np.int64)  # the reference walk
+    for pos, leaf in enumerate(leaves):
+        members = len(leaf.members)
+        leaf_masked = np.zeros(dim, dtype=np.int64)
+        for batch_ix, start in enumerate(range(0, members, batch)):
+            rows = min(batch, members - start)
+            inputs = _batch_rng(seed, leaf.group, batch_ix, "x").integers(
+                0, modulus, size=(rows, dim), dtype=np.int64)
+            masks = _batch_rng(seed, leaf.group, batch_ix, "m").integers(
+                0, modulus, size=(rows, dim), dtype=np.int64)
+            masked = (inputs + masks) % modulus
+            observe(inputs, masks, masked)
+            # object dtype for the column sums: rows x modulus exceeds
+            # int64 long before 1e5 rows (bit-exactness, not speed)
+            leaf_masked = (leaf_masked
+                           + masked.astype(object).sum(axis=0)) % modulus
+            root_mask_total = (root_mask_total
+                               + masks.astype(object).sum(axis=0)) % modulus
+            flat_total = (flat_total
+                          + inputs.astype(object).sum(axis=0)) % modulus
+        # the relay reduces mod m before re-sharing (client/relay.py)
+        masked_leaf_totals[pos] = leaf_masked.astype(np.int64)
+
+    # -- upper levels: each internal round sums its children's (already
+    # reduced) relay inputs; the root unmasks with every forwarded mask
+    tree_masked_total = (
+        masked_leaf_totals.astype(object).sum(axis=0) % modulus)
+    tree_total = (tree_masked_total - root_mask_total) % modulus
+    exact = bool((tree_total == flat_total).all())
+    _, traced_peak = tracemalloc.get_traced_memory()
+    peak_pass_bytes = max(0, traced_peak - baseline)
+    if not was_tracing:
+        tracemalloc.stop()
+    # the measured bound: the streamed batch arrays (int64 inputs/masks/
+    # masked plus transient temporaries of the modular ops and the
+    # object-dtype column sums) — a generous constant factor of the
+    # batch footprint plus fixed slack, NEVER a function of N
+    bound_pass_bytes = 8 * bound_elements * 4 + (1 << 20)
+    seconds = time.perf_counter() - t0
+
+    shard_sizes = [len(leaf.members) for leaf in leaves]
+    return {
+        "metric": (f"tree sim throughput ({participants} participants, "
+                   f"depth {plan.depth()}, streamed batch {batch})"),
+        "value": round(participants / max(seconds, 1e-9), 1),
+        "unit": "participants/sec",
+        "platform": "cpu",
+        "seed": seed,
+        "mode": "simulated tree round (real planner, modular algebra, "
+                "streamed batches)",
+        "participants": participants,
+        "dim": dim,
+        "modulus": modulus,
+        "groups": len(leaves),
+        "depth": plan.depth(),
+        "group_min": min(shard_sizes),
+        "group_max": max(shard_sizes),
+        "levels": plan.level_table(_SimScheme()),
+        "batch": batch,
+        "seconds": round(seconds, 4),
+        "exact": exact,
+        # the bounded-memory verdict the acceptance gates on: measured
+        # allocation peak of the streaming pass (tracemalloc) vs the
+        # batch-derived bound — both independent of N — plus the
+        # explicit per-batch element count as a cross-check
+        "peak_node_elements": peak_node_elements,
+        "bound_elements": bound_elements,
+        "peak_pass_bytes": peak_pass_bytes,
+        "bound_pass_bytes": bound_pass_bytes,
+        "bounded": (peak_node_elements <= bound_elements
+                    and peak_pass_bytes <= bound_pass_bytes),
+    }
+
+
+class _SimScheme:
+    """Committee-shape stand-in for the simulator's level table (the sim
+    has no crypto; the drill committees are the HTTP drills' business)."""
+
+    output_size = 8
+    privacy_threshold = 4
+    reconstruction_threshold = 7

@@ -1,0 +1,101 @@
+"""Group sharding over the consistent-hash ring (server/routing.py via
+sda_tpu.tree.plan.shard_groups) — the population-sharding satellite of
+the tree subsystem: deterministic assignment at a fixed key set, rough
+balance across G groups, and minimal movement when G changes by one.
+"""
+
+import pytest
+
+from sda_tpu.server.routing import HashRing
+from sda_tpu.tree.plan import shard_groups
+
+
+def keys(n, tag="agent"):
+    return [f"{tag}-{ix:06d}" for ix in range(n)]
+
+
+def assignment(shards):
+    return {key: ix for ix, shard in enumerate(shards) for key in shard}
+
+
+class TestDeterminism:
+    def test_same_keys_same_shards(self):
+        population = keys(500)
+        assert shard_groups(population, 7) == shard_groups(population, 7)
+
+    def test_order_independent(self):
+        """Assignment is a pure function of the key, so feeding the
+        population in a different order shards every key identically."""
+        population = keys(300)
+        forward = assignment(shard_groups(population, 5))
+        backward = assignment(shard_groups(list(reversed(population)), 5))
+        assert forward == backward
+
+    def test_matches_ring_directly(self):
+        """shard_groups IS the serving ring's mapping — no parallel
+        hashing scheme to drift from routing."""
+        population = keys(64)
+        ring = HashRing([f"group-{ix}" for ix in range(4)])
+        got = assignment(shard_groups(population, 4))
+        for key in population:
+            assert f"group-{got[key]}" == ring.node_for(key)
+
+    def test_single_group_takes_all(self):
+        population = keys(40)
+        shards = shard_groups(population, 1)
+        assert shards == [population]
+
+    def test_zero_groups_rejected(self):
+        with pytest.raises(ValueError):
+            shard_groups(keys(4), 0)
+
+
+class TestBalance:
+    def test_rough_balance_across_groups(self):
+        """The Karger ring with 64 vnodes per group is statistically
+        balanced, not perfectly: with a healthy population every group
+        must land within a loose factor of the fair share, and no group
+        may be empty."""
+        population = keys(4000)
+        groups = 8
+        sizes = [len(s) for s in shard_groups(population, groups)]
+        fair = len(population) / groups
+        assert sum(sizes) == len(population)
+        assert min(sizes) > 0
+        assert max(sizes) < 2.5 * fair
+        assert min(sizes) > fair / 3.5
+
+    def test_more_replicas_tighten_balance(self):
+        population = keys(4000)
+        loose = [len(s) for s in shard_groups(population, 8, replicas=8)]
+        tight = [len(s) for s in shard_groups(population, 8, replicas=256)]
+
+        def spread(sizes):
+            return max(sizes) - min(sizes)
+
+        assert spread(tight) <= spread(loose)
+
+
+class TestMinimalMovement:
+    def test_adding_one_group_moves_about_one_share(self):
+        """G -> G+1 must only move ~1/(G+1) of the population (the ring
+        property the fleet already relies on for worker churn): movement
+        stays well under a full reshuffle, and every key that moved,
+        moved INTO the new group — no lateral churn between survivors."""
+        population = keys(3000)
+        groups = 9
+        before = assignment(shard_groups(population, groups))
+        after = assignment(shard_groups(population, groups + 1))
+        moved = [key for key in population if before[key] != after[key]]
+        fair_share = len(population) / (groups + 1)
+        assert len(moved) < 2.5 * fair_share  # vs ~N*(G/(G+1)) reshuffled
+        assert all(after[key] == groups for key in moved)
+
+    def test_removing_one_group_only_drains_it(self):
+        population = keys(3000)
+        groups = 10
+        before = assignment(shard_groups(population, groups))
+        after = assignment(shard_groups(population, groups - 1))
+        for key in population:
+            if before[key] != groups - 1:  # survivors keep their group
+                assert after[key] == before[key]

@@ -1,0 +1,201 @@
+"""Streamed snapshot-mask collection (server/snapshot.py +
+put_snapshot_mask_chunk across the store backends): pipeline memory must
+stay O(batch) while the durable mask and the reveal stay bit-identical —
+the tree-scale satellite of the hierarchy PR.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sda_tpu.protocol import Encryption, SnapshotId
+from sda_tpu.server import new_jsonfs_server, new_memory_server, new_sqlite_server
+
+
+def enc(ix):
+    return Encryption.sodium(b"mask-%04d" % ix)
+
+
+@pytest.fixture(params=["memory", "sqlite", "jsonfs"])
+def agg_store(request, tmp_path):
+    if request.param == "memory":
+        service = new_memory_server()
+    elif request.param == "sqlite":
+        service = new_sqlite_server(str(tmp_path / "db.sqlite"))
+    else:
+        service = new_jsonfs_server(str(tmp_path / "jfs"))
+    return service.server.aggregation_store
+
+
+class TestChunkStore:
+    def test_chunks_concatenate_in_order(self, agg_store):
+        snap = SnapshotId.random()
+        agg_store.put_snapshot_mask_chunk(snap, 0, [enc(0), enc(1)])
+        agg_store.put_snapshot_mask_chunk(snap, 1, [enc(2)])
+        agg_store.put_snapshot_mask_chunk(snap, 2, [enc(3), enc(4)])
+        assert agg_store.get_snapshot_mask(snap) == [enc(i) for i in range(5)]
+
+    def test_trim_drops_excess_chunks(self, agg_store):
+        """A replay chunked with a LARGER batch (fewer chunks) ends with
+        a trim that drops the crashed predecessor's excess chunks."""
+        snap = SnapshotId.random()
+        for ix in range(4):
+            agg_store.put_snapshot_mask_chunk(snap, ix, [enc(100 + ix)])
+        agg_store.put_snapshot_mask_chunk(snap, 0, [enc(0)])
+        agg_store.put_snapshot_mask_chunk(snap, 1, [enc(1)])
+        agg_store.trim_snapshot_mask_chunks(snap, 2)
+        assert agg_store.get_snapshot_mask(snap) == [enc(0), enc(1)]
+
+    def test_contended_identical_streams_converge(self, agg_store):
+        """Two fleet workers replaying one pipeline write IDENTICAL chunk
+        sequences (same frozen set, same batch size); chunk writes are
+        pure upserts, so EVERY intermediate interleaving shows a correct
+        prefix-or-complete mask and the end state is exact."""
+        snap = SnapshotId.random()
+        stream = [(0, [enc(0), enc(1)]), (1, [enc(2)]), (2, [enc(3)])]
+        # worker A writes 0,1; worker B replays the whole stream; worker
+        # A finishes with its identical chunk 2 — and after B's chunk 2
+        # landed, no later write can make the mask regress
+        agg_store.put_snapshot_mask_chunk(snap, *stream[0])
+        agg_store.put_snapshot_mask_chunk(snap, *stream[1])
+        for ix, chunk in stream:
+            agg_store.put_snapshot_mask_chunk(snap, ix, chunk)
+        complete = [enc(i) for i in range(4)]
+        assert agg_store.get_snapshot_mask(snap) == complete
+        agg_store.put_snapshot_mask_chunk(snap, *stream[2])
+        agg_store.trim_snapshot_mask_chunks(snap, 3)
+        assert agg_store.get_snapshot_mask(snap) == complete
+
+    def test_create_snapshot_mask_still_whole(self, agg_store):
+        """The legacy one-shot API keeps working (chunk 0 underneath)."""
+        snap = SnapshotId.random()
+        agg_store.create_snapshot_mask(snap, [enc(0), enc(1)])
+        assert agg_store.get_snapshot_mask(snap) == [enc(0), enc(1)]
+        agg_store.create_snapshot_mask(snap, [enc(9)])
+        assert agg_store.get_snapshot_mask(snap) == [enc(9)]
+
+    def test_missing_mask_is_none(self, agg_store):
+        assert agg_store.get_snapshot_mask(SnapshotId.random()) is None
+
+
+class TestLegacyFallback:
+    def test_sqlite_reads_pre_chunking_rows(self, tmp_path):
+        store = new_sqlite_server(
+            str(tmp_path / "db.sqlite")).server.aggregation_store
+        snap = SnapshotId.random()
+        store._exec(
+            "INSERT INTO snapshot_masks (snapshot, doc) VALUES (?, ?)",
+            (str(snap), json.dumps([enc(0).to_obj(), enc(1).to_obj()])),
+        )
+        assert store.get_snapshot_mask(snap) == [enc(0), enc(1)]
+
+    def test_jsonfs_reads_pre_chunking_file(self, tmp_path):
+        store = new_jsonfs_server(str(tmp_path / "jfs")).server \
+            .aggregation_store
+        snap = SnapshotId.random()
+        path = store.root / "masks" / f"{snap}.json"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps([enc(7).to_obj()]))
+        assert store.get_snapshot_mask(snap) == [enc(7)]
+
+
+class TestPipelineBounded:
+    """The snapshot pipeline itself: O(batch) chunks, bit-exact reveal."""
+
+    def test_full_round_streams_bounded_chunks(self, monkeypatch):
+        from sda_tpu.crypto import sodium
+
+        if not sodium.available():
+            pytest.skip("libsodium not present")
+        from test_full_loop import agg_default, new_client
+
+        monkeypatch.setenv("SDA_SNAPSHOT_MASK_BATCH", "4")
+        service = new_memory_server()
+        store = service.server.aggregation_store
+        chunks = []
+        original = store.put_snapshot_mask_chunk
+
+        def recording(snapshot, index, encryptions):
+            chunks.append((index, len(encryptions)))
+            return original(snapshot, index, encryptions)
+
+        monkeypatch.setattr(store, "put_snapshot_mask_chunk", recording)
+
+        from sda_tpu.protocol import FullMasking
+
+        aggregation = agg_default().replace(masking_scheme=FullMasking(433))
+        recipient = new_client(service)
+        recipient_key = recipient.new_encryption_key()
+        recipient.upload_agent()
+        recipient.upload_encryption_key(recipient_key)
+        aggregation = aggregation.replace(
+            recipient=recipient.agent.id, recipient_key=recipient_key)
+        recipient.upload_aggregation(aggregation)
+        clerks = [new_client(service) for _ in range(3)]
+        for clerk in clerks:
+            clerk.upload_agent()
+            clerk.upload_encryption_key(clerk.new_encryption_key())
+        recipient.begin_aggregation(aggregation.id)
+        for _ in range(10):
+            participant = new_client(service)
+            participant.upload_agent()
+            participant.participate([1, 2, 3, 4], aggregation.id)
+        recipient.end_aggregation(aggregation.id)
+
+        # the memory bound: 10 masks through batch 4 -> chunks 4/4/2,
+        # never a full-population materialization
+        assert chunks == [(0, 4), (1, 4), (2, 2)]
+        for clerk in [recipient] + clerks:
+            clerk.run_chores(-1)
+        output = recipient.reveal_aggregation(aggregation.id)
+        np.testing.assert_array_equal(
+            output.positive().values, [10, 20, 30, 40])
+
+    def test_replayed_pipeline_converges(self, monkeypatch):
+        """Re-running the snapshot pipeline (crash replay / contended
+        peer) rewrites the identical chunk stream — the stored mask is
+        unchanged."""
+        from sda_tpu.crypto import sodium
+
+        if not sodium.available():
+            pytest.skip("libsodium not present")
+        from test_full_loop import agg_default, new_client
+
+        from sda_tpu.protocol import FullMasking, Snapshot, SnapshotId
+        from sda_tpu.server import snapshot as snapshot_mod
+
+        monkeypatch.setenv("SDA_SNAPSHOT_MASK_BATCH", "2")
+        service = new_memory_server()
+        aggregation = agg_default().replace(masking_scheme=FullMasking(433))
+        recipient = new_client(service)
+        recipient_key = recipient.new_encryption_key()
+        recipient.upload_agent()
+        recipient.upload_encryption_key(recipient_key)
+        aggregation = aggregation.replace(
+            recipient=recipient.agent.id, recipient_key=recipient_key)
+        recipient.upload_aggregation(aggregation)
+        for _ in range(3):
+            clerk = new_client(service)
+            clerk.upload_agent()
+            clerk.upload_encryption_key(clerk.new_encryption_key())
+        recipient.begin_aggregation(aggregation.id)
+        for _ in range(5):
+            participant = new_client(service)
+            participant.upload_agent()
+            participant.participate([1, 2, 3, 4], aggregation.id)
+
+        snap = Snapshot(id=SnapshotId.random(), aggregation=aggregation.id)
+        assert snapshot_mod.snapshot(service.server, snap) is True
+        store = service.server.aggregation_store
+        first = store.get_snapshot_mask(snap.id)
+        assert len(first) == 5
+        # replay: the record exists, the pipeline short-circuits and the
+        # mask is untouched
+        assert snapshot_mod.snapshot(service.server, snap) is False
+        assert store.get_snapshot_mask(snap.id) == first
+        # a second worker racing BEFORE the record commit re-runs the
+        # collection against the same frozen set: identical chunks
+        snapshot_mod._collect_masks_streamed(
+            service.server, aggregation, snap)
+        assert store.get_snapshot_mask(snap.id) == first

@@ -1,0 +1,229 @@
+"""The tree planner (sda_tpu/tree/plan.py): topology, deterministic ids,
+and the privacy-threshold / quorum composition math — including the
+degenerate G=1 tree, whose leaf round is scheme-identical to a flat
+round (the bit-exact end-to-end half lives in test_tree_round.py).
+"""
+
+import pytest
+
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    AgentId,
+    EncryptionKeyId,
+    FullMasking,
+    PackedShamirSharing,
+    SodiumEncryption,
+)
+from sda_tpu.tree.plan import plan_tree
+
+PACKED = PackedShamirSharing(
+    secret_count=3, share_count=8, privacy_threshold=4,
+    prime_modulus=433, omega_secrets=354, omega_shares=150,
+)
+ADDITIVE = AdditiveSharing(share_count=3, modulus=433)
+
+
+def participants(n):
+    return [f"p-{ix:05d}" for ix in range(n)]
+
+
+class TestTopology:
+    def test_two_level_tree_by_default(self):
+        plan = plan_tree(participants(100), group_size=10)
+        assert plan.depth() == 2
+        leaves = plan.leaves()
+        assert len(leaves) == 10
+        assert sum(len(leaf.members) for leaf in leaves) == 100
+        assert plan.root.level == 0
+        assert all(leaf.level == 1 for leaf in leaves)
+        assert all(leaf.parent is plan.root for leaf in leaves)
+
+    def test_degenerate_single_group_is_leaf_plus_root(self):
+        """G=1: one leaf holding everyone under one root — a flat round
+        plus exactly one relay hop."""
+        plan = plan_tree(participants(7), group_size=16)
+        assert plan.depth() == 2
+        assert len(plan.leaves()) == 1
+        assert plan.leaves()[0].members == participants(7)
+        assert len(plan.relay_nodes()) == 1
+
+    def test_fanout_stacks_levels(self):
+        plan = plan_tree(participants(64), group_size=4, fanout=4)
+        assert len(plan.leaves()) == 16
+        assert plan.depth() == 3  # 16 leaves / fanout 4 -> 4 -> 1
+        for node in plan.nodes():
+            assert node.is_leaf or node.fan_in() <= 4
+
+    def test_deterministic_aggregation_ids(self):
+        a = plan_tree(participants(30), group_size=10, seed="fixed")
+        b = plan_tree(participants(30), group_size=10, seed="fixed")
+        assert [str(n.aggregation_id) for n in a.nodes()] == \
+            [str(n.aggregation_id) for n in b.nodes()]
+        c = plan_tree(participants(30), group_size=10, seed="other")
+        assert str(a.root.aggregation_id) != str(c.root.aggregation_id)
+
+    def test_empty_ring_shards_dropped(self):
+        """A ring shard with no members is dropped at plan time: every
+        planned leaf has at least one participant (an empty leaf would
+        feed a zero-length reconstruction upward), and the survivors
+        keep their ring group indices."""
+        for n in (2, 3, 5, 17):
+            plan = plan_tree(participants(n), group_size=1,
+                             seed=f"empty-{n}")
+            leaves = plan.leaves()
+            assert all(leaf.members for leaf in leaves)
+            assert sum(len(leaf.members) for leaf in leaves) == n
+            assert len({leaf.group for leaf in leaves}) == len(leaves)
+
+    def test_group_of(self):
+        plan = plan_tree(participants(40), group_size=10)
+        for leaf in plan.leaves():
+            for member in leaf.members:
+                assert plan.group_of(member) == leaf.group
+        with pytest.raises(KeyError):
+            plan.group_of("not-a-participant")
+
+
+class TestComposition:
+    def test_level_table_thresholds(self):
+        """Per-level privacy/quorum table: every level carries its
+        committee's thresholds — the composition claim is that an
+        adversary must exceed privacy_threshold at some SINGLE level."""
+        plan = plan_tree(participants(120), group_size=16)
+        table = plan.level_table(PACKED)
+        assert [row["level"] for row in table] == [0, 1]
+        root_row, leaf_row = table
+        assert root_row["kind"] == "root" and root_row["rounds"] == 1
+        assert leaf_row["kind"] == "leaf"
+        assert leaf_row["rounds"] == len(plan.leaves())
+        for row in table:
+            assert row["committee_size"] == 8
+            assert row["privacy_threshold"] == 4
+            assert row["reconstruction_threshold"] == 7  # t + k
+        assert root_row["max_fan_in"] == len(plan.leaves())
+        assert leaf_row["max_fan_in"] == max(
+            len(leaf.members) for leaf in plan.leaves())
+
+    def test_mixed_schemes_per_level(self):
+        plan = plan_tree(participants(60), group_size=20)
+        table = plan.level_table(PACKED, internal_sharing=ADDITIVE)
+        root_row, leaf_row = table
+        assert leaf_row["privacy_threshold"] == 4
+        assert leaf_row["reconstruction_threshold"] == 7
+        # additive at the root: n-of-n — everyone is required
+        assert root_row["privacy_threshold"] == 2
+        assert root_row["reconstruction_threshold"] == 3
+
+    def test_degenerate_tree_matches_flat_committee(self):
+        """G=1 leaf round == the flat round's committee shape: same
+        scheme object, same thresholds — flat-equivalence at the math
+        level (bit-exact reveal pinned end-to-end elsewhere)."""
+        plan = plan_tree(participants(9), group_size=9)
+        table = plan.level_table(PACKED)
+        leaf_row = table[1]
+        assert leaf_row["rounds"] == 1
+        assert leaf_row["max_fan_in"] == 9
+        assert leaf_row["committee_size"] == PACKED.output_size
+        assert leaf_row["privacy_threshold"] == PACKED.privacy_threshold
+        assert (leaf_row["reconstruction_threshold"]
+                == PACKED.reconstruction_threshold)
+
+    def test_headroom_one_ring_is_wrap_free(self):
+        """modulus == prime: all arithmetic is mod p, no headroom needed
+        no matter the fan-in (the drill committees' configuration)."""
+        plan = plan_tree(participants(400), group_size=100)
+        plan.validate_headroom(433, PACKED)  # must not raise
+
+    def test_headroom_two_ring_guard(self):
+        """modulus < prime: the exact integer sum must fit under the
+        prime, so an oversized fan-in is rejected at PLAN time, not
+        discovered as a silently wrong reveal."""
+        scheme = PackedShamirSharing(
+            secret_count=3, share_count=8, privacy_threshold=4,
+            prime_modulus=433, omega_secrets=354, omega_shares=150,
+        )
+        small = plan_tree(participants(8), group_size=2)
+        small.validate_headroom(100, scheme)  # 2 * 99 < 433: fine
+        big = plan_tree(participants(80), group_size=10)
+        with pytest.raises(ValueError, match="headroom"):
+            big.validate_headroom(100, scheme)  # 10 * 99 >= 433
+
+
+class TestBuildAggregations:
+    def _relays(self, plan):
+        return [(AgentId.random(), EncryptionKeyId.random())
+                for _ in plan.relay_nodes()]
+
+    def _build(self, plan, **overrides):
+        root_recipient = overrides.pop("root_recipient", AgentId.random())
+        root_key = overrides.pop("root_recipient_key",
+                                 EncryptionKeyId.random())
+        kwargs = dict(
+            title="t", vector_dimension=4, modulus=433,
+            masking_scheme=FullMasking(433),
+            leaf_sharing=ADDITIVE,
+            recipient_encryption_scheme=SodiumEncryption(),
+            committee_encryption_scheme=SodiumEncryption(),
+            root_recipient=root_recipient,
+            root_recipient_key=root_key,
+            relays=overrides.pop("relays", self._relays(plan)),
+        )
+        kwargs.update(overrides)
+        return root_recipient, root_key, plan.build_aggregations(**kwargs)
+
+    def test_tree_links_wired(self):
+        plan = plan_tree(participants(30), group_size=10)
+        root_recipient, root_key, aggs = self._build(plan)
+        root_agg = aggs[plan.root.path]
+        assert root_agg.tree.parent is None
+        assert root_agg.recipient == root_recipient
+        assert len(root_agg.tree.children) == 3
+        # the root's own masks already seal to its recipient: no redirect
+        assert root_agg.tree.mask_recipient_key is None
+        for leaf in plan.leaves():
+            agg = aggs[leaf.path]
+            assert agg.tree.root == plan.root.aggregation_id
+            assert agg.tree.parent == plan.root.aggregation_id
+            assert agg.tree.level == 1 and agg.tree.group == leaf.group
+            # the privacy hinge: leaf masks seal to the ROOT, past the relay
+            assert agg.tree.mask_recipient == root_recipient
+            assert agg.tree.mask_recipient_key == root_key
+            assert agg.recipient != root_recipient
+            assert agg.id in root_agg.tree.children
+
+    def test_serde_round_trip(self):
+        from sda_tpu.protocol import Aggregation
+
+        plan = plan_tree(participants(12), group_size=6)
+        _, _, aggs = self._build(plan)
+        for agg in aggs.values():
+            back = Aggregation.from_obj(agg.to_obj())
+            assert back == agg
+            assert back.tree.to_obj() == agg.tree.to_obj()
+
+    def test_flat_wire_shape_unchanged(self):
+        """A flat aggregation serializes WITHOUT a tree key — the exact
+        reference wire shape old peers parse."""
+        from sda_tpu.protocol import Aggregation, AggregationId
+
+        flat = Aggregation(
+            id=AggregationId.random(), title="flat", vector_dimension=4,
+            modulus=433, recipient=AgentId.random(),
+            recipient_key=EncryptionKeyId.random(),
+            masking_scheme=FullMasking(433),
+            committee_sharing_scheme=ADDITIVE,
+            recipient_encryption_scheme=SodiumEncryption(),
+            committee_encryption_scheme=SodiumEncryption(),
+        )
+        assert "tree" not in flat.to_obj()
+
+    def test_relay_count_mismatch_rejected(self):
+        plan = plan_tree(participants(30), group_size=10)
+        with pytest.raises(ValueError, match="relay"):
+            self._build(plan, relays=[(AgentId.random(),
+                                       EncryptionKeyId.random())])
+
+    def test_mask_ring_mismatch_rejected(self):
+        plan = plan_tree(participants(10), group_size=5)
+        with pytest.raises(ValueError, match="ring"):
+            self._build(plan, masking_scheme=FullMasking(101))

@@ -1,0 +1,304 @@
+"""End-to-end hierarchical rounds through the real server stack
+(sda_tpu/tree/round.py + client/relay.py): bit-exactness vs the flat
+reference (including the degenerate G=1 tree), per-level privacy
+mechanics (masks sealed past the relay), quorum-degraded leaves feeding
+survivors up, failed leaves failing the root with a reason naming the
+leaf, and parent/child linkage on the round documents.
+"""
+
+import numpy as np
+import pytest
+
+from sda_tpu.crypto import sodium
+from sda_tpu.server import lifecycle, new_memory_server
+from sda_tpu.tree import run_tree_round
+
+pytestmark = pytest.mark.skipif(not sodium.available(),
+                                reason="libsodium not present")
+
+
+def inputs_for(n, dim=4, seed=0, modulus=433):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, modulus, size=(n, dim), dtype=np.int64)
+
+
+class TestBitExact:
+    def test_tree_reveals_flat_sum(self):
+        report = run_tree_round(
+            inputs_for(9), group_size=4, sharing="additive",
+            masking="full", seed=7)
+        assert report["exact"] is True
+        assert report["flat_exact"] is True
+        assert report["depth"] == 2
+        assert report["root_state"] == "revealed"
+        assert report["relays"] == report["groups"]
+
+    def test_degenerate_single_group_equals_flat(self):
+        """G=1: every participant in one leaf, one relay hop — the tree
+        reveal is bit-exact with the flat reference round."""
+        report = run_tree_round(
+            inputs_for(6, seed=3), group_size=32, sharing="additive",
+            masking="full", seed=3)
+        assert report["groups"] == 1
+        assert report["exact"] is True
+        assert report["flat_exact"] is True
+
+    def test_chacha_masking_forwards_seeds(self):
+        report = run_tree_round(
+            inputs_for(8, seed=5), group_size=3, sharing="additive",
+            masking="chacha", seed=5)
+        assert report["exact"] is True
+        assert report["flat_exact"] is True
+        assert report["counters"].get("relay.masks_forwarded", 0) == 8
+
+    def test_dropout_shrinks_the_sum_exactly(self):
+        report = run_tree_round(
+            inputs_for(12, seed=11), group_size=4, sharing="additive",
+            masking="full", seed=11, dropout_rate=0.5)
+        assert report["participants_dropped"] >= 1
+        assert report["exact"] is True
+        assert report["flat_exact"] is True
+
+
+class TestRelayPrivacy:
+    def test_masks_seal_past_the_relay(self):
+        """The privacy hinge, mechanically: every leaf mask ciphertext
+        opens with the ROOT's key (the exact reveal proves it) and the
+        relay's own key CANNOT open it — a relay never sees an unmasked
+        value."""
+        from sda_tpu.client import SdaClient
+        from sda_tpu.crypto import MemoryKeystore
+        from sda_tpu.protocol import (
+            AdditiveSharing, FullMasking, SodiumEncryption)
+        from sda_tpu.tree.plan import plan_tree
+
+        service = new_memory_server()
+
+        def new_client():
+            keystore = MemoryKeystore()
+            agent = SdaClient.new_agent(keystore)
+            client = SdaClient(agent, keystore, service)
+            client.upload_agent()
+            return client
+
+        root = new_client()
+        root_key = root.new_encryption_key()
+        root.upload_encryption_key(root_key)
+        relay = new_client()
+        relay_key = relay.new_encryption_key()
+        relay.upload_encryption_key(relay_key)
+        clerks = []
+        for _ in range(3):
+            clerk = new_client()
+            clerk.upload_encryption_key(clerk.new_encryption_key())
+            clerks.append(clerk)
+        participant = new_client()
+
+        plan = plan_tree([str(participant.agent.id)], group_size=4)
+        aggs = plan.build_aggregations(
+            title="privacy", vector_dimension=4, modulus=433,
+            masking_scheme=FullMasking(433),
+            leaf_sharing=AdditiveSharing(share_count=3, modulus=433),
+            recipient_encryption_scheme=SodiumEncryption(),
+            committee_encryption_scheme=SodiumEncryption(),
+            root_recipient=root.agent.id, root_recipient_key=root_key,
+            relays=[(relay.agent.id, relay_key)],
+        )
+        leaf = plan.leaves()[0]
+        relay.upload_aggregation(aggs[leaf.path])
+        relay.begin_aggregation_with(
+            leaf.aggregation_id, [c.agent.id for c in clerks])
+        participant.participate([1, 2, 3, 4], leaf.aggregation_id)
+        uploaded = list(
+            service.server.aggregation_store._participations[
+                leaf.aggregation_id].values())
+        assert len(uploaded) == 1
+        mask_ct = uploaded[0].recipient_encryption
+        assert mask_ct is not None
+        # the root opens it; the relay must not be able to
+        root_decryptor = root.crypto.new_share_decryptor(
+            root_key, aggs[leaf.path].recipient_encryption_scheme)
+        assert len(root_decryptor.decrypt(mask_ct)) == 4
+        relay_decryptor = relay.crypto.new_share_decryptor(
+            relay_key, aggs[leaf.path].recipient_encryption_scheme)
+        with pytest.raises(Exception):
+            relay_decryptor.decrypt(mask_ct)
+
+
+class TestRelayResume:
+    def test_crashed_relay_replays_byte_identically(self, tmp_path):
+        """A relay that dies in the lost-ack window (upload ingested, ack
+        never seen) must replay its journaled bytes on restart — the
+        server dedupes the byte-identical re-upload instead of rejecting
+        a fresh-randomness recompute as an equivocation."""
+        from sda_tpu.client import SdaClient, relay
+        from sda_tpu.client.journal import ParticipationJournal
+        from sda_tpu.crypto import MemoryKeystore
+        from sda_tpu.protocol import (
+            AdditiveSharing, FullMasking, SodiumEncryption)
+        from sda_tpu.tree.plan import plan_tree
+        from sda_tpu.utils import metrics
+
+        service = new_memory_server()
+
+        def new_client():
+            keystore = MemoryKeystore()
+            agent = SdaClient.new_agent(keystore)
+            client = SdaClient(agent, keystore, service)
+            client.upload_agent()
+            return client
+
+        def keyed(client):
+            client.upload_encryption_key(client.new_encryption_key())
+            return client
+
+        root = new_client()
+        root_key = root.new_encryption_key()
+        root.upload_encryption_key(root_key)
+        relay_client = new_client()
+        relay_key = relay_client.new_encryption_key()
+        relay_client.upload_encryption_key(relay_key)
+        participant = new_client()
+        plan = plan_tree([str(participant.agent.id)], group_size=4,
+                         seed="resume")
+        scheme = AdditiveSharing(share_count=3, modulus=433)
+        aggs = plan.build_aggregations(
+            title="resume", vector_dimension=4, modulus=433,
+            masking_scheme=FullMasking(433), leaf_sharing=scheme,
+            recipient_encryption_scheme=SodiumEncryption(),
+            committee_encryption_scheme=SodiumEncryption(),
+            root_recipient=root.agent.id, root_recipient_key=root_key,
+            relays=[(relay_client.agent.id, relay_key)],
+        )
+        leaf = plan.leaves()[0]
+        root_node = plan.root
+        clerks = {node.path: [keyed(new_client()) for _ in range(3)]
+                  for node in plan.nodes()}
+        for node in plan.nodes():
+            owner = root if node.is_root else relay_client
+            owner.upload_aggregation(aggs[node.path])
+            owner.begin_aggregation_with(
+                node.aggregation_id,
+                [c.agent.id for c in clerks[node.path]])
+        participant.participate([1, 2, 3, 4], leaf.aggregation_id)
+        relay_client.end_aggregation(leaf.aggregation_id)
+        for clerk in clerks[leaf.path]:
+            clerk.run_chores(-1)
+
+        # first attempt: seal + journal + upload, then "crash" before
+        # the reap — exactly what relay_up does up to the lost ack
+        journal = ParticipationJournal(str(tmp_path))
+        total = relay.await_masked(relay_client, leaf.aggregation_id,
+                                   deadline=30)
+        participation = relay_client.new_participation(
+            [int(v) for v in total.values], root_node.aggregation_id)
+        participation.forwarded_masks = list(total.mask_encryptions)
+        journal.record(participation)
+        relay_client.upload_participation(participation)  # ack "lost"
+
+        # restart: relay_up with the journal replays the SAME bytes
+        relay.relay_up(relay_client, leaf.aggregation_id,
+                       root_node.aggregation_id, deadline=30,
+                       journal=journal)
+        assert metrics.counter_report().get(
+            "server.participation.replayed", 0) >= 1
+        assert journal.load(relay_client.agent.id,
+                            root_node.aggregation_id) is None  # reaped
+        status = root.service.get_aggregation_status(
+            root.agent, root_node.aggregation_id)
+        assert status.number_of_participations == 1  # never double-counted
+
+        # the round still completes exactly
+        root.end_aggregation(root_node.aggregation_id)
+        for clerk in clerks[root_node.path]:
+            clerk.run_chores(-1)
+        out = root.await_result(root_node.aggregation_id, deadline=30)
+        np.testing.assert_array_equal(out.positive().values, [1, 2, 3, 4])
+
+
+class TestLeafFailureModes:
+    def test_dead_clerk_degrades_leaf_root_stays_exact(self):
+        """Packed Shamir leaf loses one clerk: the sweeper declares the
+        leaf degraded, the relay completes from the surviving quorum,
+        and the ROOT round reveals bit-exactly."""
+        report = run_tree_round(
+            inputs_for(8, seed=3), group_size=4, sharing="packed",
+            masking="full", seed=3, dead_clerks_leaf=1)
+        leaf_states = {path: s for path, s in report["node_states"].items()
+                       if s.get("group") is not None}
+        assert report["node_states"][report["dead_clerk_leaf"]][
+            "state"] == "degraded"
+        assert report["root_state"] == "revealed"
+        assert report["exact"] is True
+        assert report["flat_exact"] is True
+        # the other leaf was untouched (disjoint committees)
+        others = [s for path, s in leaf_states.items()
+                  if path != report["dead_clerk_leaf"]]
+        assert all(s["state"] == "ready" for s in others)
+
+    def test_failed_leaf_fails_root_naming_the_leaf(self):
+        """Additive leaf loses a clerk: unrecoverable — the leaf goes
+        terminal failed and the sweeper's tree propagation fails the
+        ROOT with a machine-readable reason naming the child round."""
+        report = run_tree_round(
+            inputs_for(8, seed=3), group_size=4, sharing="additive",
+            masking="full", seed=3, dead_clerks_leaf=1,
+            flat_reference=False)
+        dead_leaf = report["dead_clerk_leaf"]
+        leaf_state = report["node_states"][dead_leaf]
+        assert leaf_state["state"] == "failed"
+        assert report["root_state"] == "failed"
+        assert report["failure"]["type"] == "RoundFailed"
+        # machine-readable: the root's reason names the failed child
+        failed_leaf_id = [
+            str(s) for s in report["root_children"]
+        ]
+        assert "child round" in report["root_reason"]
+        assert any(cid in report["root_reason"] for cid in failed_leaf_id)
+        assert "additive sharing cannot recover" in report["root_reason"]
+
+
+class TestLinkage:
+    def test_round_documents_expose_parent_and_children(self):
+        """RoundStatus + the /statusz rounds table carry the tree
+        linkage: the root names its children, each leaf its parent — a
+        stuck tree is diagnosable from any worker."""
+        service = new_memory_server()
+        report = run_tree_round(
+            inputs_for(6, seed=9), group_size=3, sharing="additive",
+            masking="full", seed=9, service=service,
+            flat_reference=False)
+        assert report["exact"] is True
+        docs = service.server.aggregation_store.list_round_states()
+        by_id = {d["aggregation"]: d for d in docs}
+        roots = [d for d in docs if d.get("children")]
+        assert len(roots) == 1
+        root_doc = roots[0]
+        assert root_doc.get("parent") is None
+        assert len(root_doc["children"]) == report["groups"]
+        for child_id in root_doc["children"]:
+            child = by_id[child_id]
+            assert child["parent"] == root_doc["aggregation"]
+            assert child["level"] == 1
+            assert child["group"] is not None
+        # the /statusz table rows carry the linkage too
+        table = lifecycle.rounds_report(service.server, limit=16)
+        rows = {r["aggregation"]: r for r in table["recent"]}
+        assert rows[root_doc["aggregation"]]["children"] == \
+            root_doc["children"]
+        assert rows[root_doc["children"][0]]["parent"] == \
+            root_doc["aggregation"]
+
+    def test_round_status_serde_carries_linkage(self):
+        from sda_tpu.protocol import AggregationId, RoundStatus
+
+        status = RoundStatus(
+            aggregation=AggregationId("11111111-1111-1111-1111-111111111111"),
+            state="clerking",
+            parent="22222222-2222-2222-2222-222222222222",
+            children=["33333333-3333-3333-3333-333333333333"],
+        )
+        back = RoundStatus.from_obj(status.to_obj())
+        assert str(back.parent) == "22222222-2222-2222-2222-222222222222"
+        assert [str(c) for c in back.children] == [
+            "33333333-3333-3333-3333-333333333333"]
