@@ -254,6 +254,51 @@ PY
 python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$FLEET_RECORD"
 rm -f "$FLEET_RECORD"
 
+echo "== soak drill (fixed seed: 2 tenants x 3 pipelined epochs, sqlite + HTTP fleet of 2, ~10% chaos, churn armed; bit-exact per epoch, flat store after retention)"
+SOAK_RECORD=$(mktemp /tmp/sda-soak-XXXX.json)
+SOAK=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --soak \
+  --soak-tenants 2 --soak-epochs 3 --soak-participants 4 \
+  --soak-store sqlite --soak-fleet 2 --soak-chaos-rate 0.1 \
+  --soak-churn 0.4 --soak-seed 20260803)
+SOAK="$SOAK" SOAK_RECORD="$SOAK_RECORD" python - <<'PY'
+import json, os
+report = json.loads(os.environ["SOAK"].strip().splitlines()[-1])
+# the continuous-service verdict: every tenant's every epoch revealed
+# bit-exactly, epoch R+1 collected while epoch R clerked (server-stamped
+# history), and nothing leaked across epochs or tenants
+assert report["exact"] is True, report
+assert report["rounds_exact"] == report["rounds"] == 6, report
+assert report["pipelined"] is True, report["pipelined_pairs"]
+assert report["leaks"] == 0, report
+assert report["client_failures"] == 0, report
+# the scheduler really was contended (two handles race every mint) and
+# every epoch was minted exactly once
+sched = report["scheduler"]
+assert sched["epochs_minted"] == 6, sched
+# churned devices all rejoined via their journals
+churn = report["churn"]
+assert churn["participants_churned"] >= 1, churn
+assert churn["participants_resumed"] == churn["participants_churned"], churn
+# retention kept the store flat: every revealed round purged, zero
+# leaked rows between epoch 2 and the final epoch, worker RSS flat
+retention = report["retention"]
+assert retention["purged_rounds"] == 6, retention
+assert retention["store_rows_flat"] is True, retention
+assert retention["rss_flat"] in (True, None), retention
+assert report["fleet"]["leaked"] == 0, report["fleet"]
+with open(os.environ["SOAK_RECORD"], "w") as f:
+    json.dump(report, f)
+print(f"soak drill OK: {report['rounds_exact']}/{report['rounds']} epochs "
+      f"exact, pipelined {report['pipelined_pairs']}, "
+      f"{retention['purged_rounds']} rounds purged, store rows "
+      f"{retention['store_rows_epoch2']}->{retention['store_rows_final']}, "
+      f"{report['value']} rounds/hour sustained")
+PY
+# the rounds_per_hour record must parse as a bench record and gate
+# (advisory: first record of its metric seeds the trailing window)
+python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$SOAK_RECORD"
+rm -f "$SOAK_RECORD"
+
 echo "== trace smoke (fixed seed: Chrome-trace export, one connected round trace, bit-exact)"
 TRACE_OUT=$(mktemp /tmp/sda-trace-XXXX.json)
 TRACE_REPORT=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 12 --dim 4 \

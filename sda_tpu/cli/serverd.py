@@ -54,6 +54,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "(default: unlimited)")
     parser.add_argument("--rate-burst", type=float, metavar="N", default=8.0,
                         help="token-bucket burst capacity per agent")
+    parser.add_argument("--tenant-rate", type=float, metavar="RPS",
+                        default=None,
+                        help="multi-tenant fairness: per-recipient budget "
+                             "bucket keyed by the X-SDA-Tenant header — a "
+                             "hot tenant sheds 429 against its OWN budget "
+                             "before touching the shared in-flight cap "
+                             "(default: no tenant budgets; docs/service.md)")
+    parser.add_argument("--tenant-burst", type=float, metavar="N",
+                        default=32.0,
+                        help="per-tenant budget burst capacity "
+                             "(--tenant-rate)")
     parser.add_argument("--node-id", metavar="NAME", default=None,
                         help="fleet worker identity (sda-fleet): rides "
                              "every response as X-SDA-Node, labels /metrics "
@@ -90,6 +101,33 @@ def build_parser() -> argparse.ArgumentParser:
                              "degrade to the surviving quorum, additive "
                              "rounds fail with a diagnosis (needs "
                              "--round-sweep)")
+    parser.add_argument("--retain-revealed", type=float, metavar="SECONDS",
+                        default=None,
+                        help="retention: a revealed round older than "
+                             "SECONDS transitions to terminal 'expired' "
+                             "and is cascade-purged from every store "
+                             "backend — aggregation, round doc, "
+                             "participations + owner markers, clerking "
+                             "jobs/results, snapshot mask chunks — so a "
+                             "long-running service stays flat in store "
+                             "size (needs --round-sweep; docs/service.md)")
+    parser.add_argument("--retain-failed", type=float, metavar="SECONDS",
+                        default=None,
+                        help="retention: failed/expired rounds older than "
+                             "SECONDS are cascade-purged (kept a while "
+                             "for diagnosis; needs --round-sweep)")
+    parser.add_argument("--schedule", metavar="SPECS.json", default=None,
+                        help="run the recurring-round scheduler in this "
+                             "worker against the spec file (a JSON list "
+                             "of ScheduleSpec objects, or {'schedules': "
+                             "[...]}): per tenant and per schedule, epoch "
+                             "R+1's aggregation is minted while epoch R "
+                             "clerks. Store-arbitrated: in a fleet every "
+                             "worker may schedule, exactly one wins each "
+                             "epoch mint (docs/service.md)")
+    parser.add_argument("--schedule-tick", type=float, metavar="SECONDS",
+                        default=1.0,
+                        help="scheduler tick cadence (--schedule)")
     parser.add_argument("--heartbeat", type=float, metavar="SECONDS",
                         default=None,
                         help="fleet health: write this worker's heartbeat "
@@ -209,6 +247,13 @@ def main(argv=None) -> int:
             collecting_s=args.round_collect_deadline,
             clerking_s=args.round_clerk_deadline,
         )
+    if args.retain_revealed is not None or args.retain_failed is not None:
+        from ..service.retention import RetentionPolicy
+
+        service.server.retention_policy = RetentionPolicy(
+            revealed_ttl_s=args.retain_revealed,
+            failed_ttl_s=args.retain_failed,
+        )
     if args.round_sweep is not None:
         from ..server import lifecycle
 
@@ -216,6 +261,18 @@ def main(argv=None) -> int:
             service.server, interval_s=args.round_sweep,
             heartbeat_suspect_s=suspect_after,
             heartbeat_dead_s=args.dead_after).start()
+    scheduler = None
+    if args.schedule:
+        from ..service.scheduler import RoundScheduler, load_specs
+
+        try:
+            specs = load_specs(args.schedule)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: cannot load schedule specs from "
+                  f"{args.schedule}: {e}", file=sys.stderr)
+            return 2
+        scheduler = RoundScheduler(
+            service.server, specs, interval_s=args.schedule_tick).start()
     heartbeat = None
     if args.heartbeat is not None:
         if not args.node_id:
@@ -238,6 +295,8 @@ def main(argv=None) -> int:
         max_inflight=args.max_inflight,
         rate_limit=args.rate_limit,
         rate_burst=args.rate_burst,
+        tenant_rate=args.tenant_rate,
+        tenant_burst=args.tenant_burst,
         metrics_endpoint=args.metrics,
         statusz_endpoint=args.statusz,
         trace_log=args.trace,
@@ -275,6 +334,11 @@ def main(argv=None) -> int:
         stop.wait()
     except KeyboardInterrupt:  # SIGINT delivered before the handler landed
         pass
+    if scheduler is not None:
+        # stop minting BEFORE the drain: a fresh epoch minted mid-drain
+        # would enqueue work this worker can no longer serve (peers pick
+        # the schedule up — the state is store-arbitrated)
+        scheduler.stop()
     if sweeper is not None:
         # stop sweeping BEFORE the drain releases leases: a sweep racing
         # the lease handback could read a transiently unleased job as dead

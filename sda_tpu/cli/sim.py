@@ -165,6 +165,55 @@ def build_parser() -> argparse.ArgumentParser:
                              "bounded per-node memory asserted) and "
                              "attach its BENCH record; 0 disables "
                              "(--tree)")
+    parser.add_argument("--soak", action="store_true",
+                        help="continuous-service profile: T tenants x R "
+                             "pipelined epochs of recurring real-crypto "
+                             "rounds (sda_tpu/service) — scheduler-minted "
+                             "epochs (epoch R+1 collecting while R "
+                             "clerks), retention purging revealed rounds, "
+                             "churn + chaos armable — asserting bit-exact "
+                             "reveals per epoch, zero cross-epoch/cross-"
+                             "tenant leakage and flat store size + RSS; "
+                             "prints a BENCH-style record whose headline "
+                             "is sustained rounds_per_hour plus a "
+                             "per-tenant capacity table (docs/service.md)")
+    parser.add_argument("--soak-tenants", type=int, metavar="T", default=4,
+                        help="tenants (recipients with recurring "
+                             "schedules) (--soak)")
+    parser.add_argument("--soak-epochs", type=int, metavar="R", default=5,
+                        help="epochs (recurring rounds) per tenant "
+                             "(--soak)")
+    parser.add_argument("--soak-participants", type=int, metavar="P",
+                        default=4,
+                        help="devices per tenant, stable across epochs "
+                             "(>= 3: the pipelining and replay probes "
+                             "reserve two) (--soak)")
+    parser.add_argument("--soak-store",
+                        choices=["memory", "sqlite", "jsonfs"],
+                        default="sqlite",
+                        help="store backend for --soak")
+    parser.add_argument("--soak-fleet", type=int, metavar="N", default=0,
+                        help="drive the soak against N real `sdad` worker "
+                             "processes over one shared store "
+                             "(--soak-store sqlite/jsonfs) (--soak)")
+    parser.add_argument("--soak-chaos-rate", type=float, default=0.0,
+                        help="also 500 this fraction of requests (--soak)")
+    parser.add_argument("--soak-churn", type=float, metavar="RATE",
+                        default=0.0,
+                        help="seeded device churn per epoch: departing "
+                             "devices journal, crash (possibly in the "
+                             "lost-ack window) and rejoin via resume "
+                             "(--soak)")
+    parser.add_argument("--soak-tenant-rate", type=float, metavar="RPS",
+                        default=None,
+                        help="arm the per-tenant admission budget at this "
+                             "rate (--soak)")
+    parser.add_argument("--soak-retain", type=float, metavar="SECONDS",
+                        default=0.0,
+                        help="revealed-round retention TTL; 0 purges a "
+                             "revealed round on the next sweep (--soak)")
+    parser.add_argument("--soak-seed", type=int, default=0,
+                        help="input/schedule/chaos seed (--soak)")
     parser.add_argument("--chaos", action="store_true",
                         help="robustness profile: run a full federated "
                              "round over real HTTP with deterministic "
@@ -492,6 +541,68 @@ def _run_tree(args) -> int:
     return 0 if ok else 1
 
 
+def _run_soak(args) -> int:
+    """--soak: the continuous-service drill — T tenants x R pipelined
+    epochs of recurring rounds through the scheduler/retention plane
+    (sda_tpu/service/soak.py), reported as one BENCH-style JSON line.
+    No mesh/JAX involved: this profile exercises the service plane —
+    recurring scheduling, tenant fairness, retention — not the kernels."""
+    import tempfile
+
+    from ..crypto import sodium
+    from ..service import SoakProfile, run_soak
+
+    if not sodium.available():
+        print("error: --soak needs libsodium (real-crypto federated rounds)",
+              file=sys.stderr)
+        return 1
+    dim = min(args.dim, 16)
+    if dim != args.dim:
+        print(f"note: --soak drills the service plane, not payload size; "
+              f"clamping to --dim {dim}", file=sys.stderr)
+    store = args.soak_store
+    if args.soak_fleet and store == "memory":
+        print("note: fleet mode needs a cross-process store; using "
+              "--soak-store sqlite", file=sys.stderr)
+        store = "sqlite"
+    with tempfile.TemporaryDirectory() as tmp:
+        report = run_soak(SoakProfile(
+            tenants=args.soak_tenants,
+            epochs=args.soak_epochs,
+            participants=args.soak_participants,
+            dim=dim,
+            seed=args.soak_seed,
+            store=store,
+            store_path=None if store == "memory" else f"{tmp}/store",
+            fleet=args.soak_fleet,
+            chaos_rate=args.soak_chaos_rate,
+            churn=args.soak_churn,
+            tenant_rate=args.soak_tenant_rate,
+            retain_revealed_s=args.soak_retain,
+        ))
+    _export_trace(args, report)
+    print(json.dumps(report))
+    retention = report["retention"]
+    ok = (
+        report["exact"]
+        and report["pipelined"]
+        and report["leaks"] == 0
+        and report["client_failures"] == 0
+        and retention["purged_rounds"] >= 1
+        # flat-store/RSS verdicts: None means "not measurable here"
+        # (e.g. off-Linux RSS) and is not a failure
+        and retention["store_rows_flat"] is not False
+        and retention["rss_flat"] is not False
+    )
+    if args.soak_churn:
+        churn = report["churn"]
+        ok = ok and (churn["participants_resumed"]
+                     == churn["participants_churned"])
+    if args.soak_fleet:
+        ok = ok and report["fleet"]["leaked"] == 0
+    return 0 if ok else 1
+
+
 def _run_chaos(args) -> int:
     """--chaos: the robustness drill — a full federated round over real
     HTTP under deterministic fault injection (sda_tpu/chaos/drill.py),
@@ -576,6 +687,8 @@ def main(argv=None) -> int:
 
     if args.load:
         return _run_load(args)
+    if args.soak:
+        return _run_soak(args)
     if args.tree:
         return _run_tree(args)
     if args.chaos:

@@ -46,6 +46,7 @@ from ..protocol import (
     signed_encryption_key_from_obj,
 )
 from ..protocol import bincodec
+from .admission import TENANT_HEADER
 
 TOKEN_ALIAS = "auth-token"
 
@@ -169,6 +170,12 @@ class SdaHttpClient(SdaService):
                              f"(expected one of {WIRE_CODECS})")
         #: set once any response carries the server's bin-codec advert
         self._peer_bin = False
+        #: multi-tenant fairness (http/admission.py): when set to the
+        #: recipient id this proxy's traffic belongs to, every request
+        #: carries it as X-SDA-Tenant so the server's per-tenant budget
+        #: bucket sees it — a device swarm that names its tenant sheds
+        #: against that tenant's own budget, not the fleet's
+        self.tenant: Optional[str] = None
         #: per-request socket timeout; constructor beats SDA_HTTP_TIMEOUT
         #: beats the historical 60 s default
         self.timeout = (
@@ -308,6 +315,8 @@ class SdaHttpClient(SdaService):
                     send_headers = dict(headers or {})
                     send_headers[obs.TRACEPARENT_HEADER] = (
                         obs.format_traceparent(att_span.context))
+                    if self.tenant:
+                        send_headers[TENANT_HEADER] = str(self.tenant)
                     try:
                         response = self.session.request(
                             method, url, params=params, json=json, data=data,

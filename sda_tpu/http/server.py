@@ -73,7 +73,7 @@ from ..server import lifecycle as _lifecycle
 from ..server.routing import NODE_HEADER
 from ..utils import metrics
 from .. import chaos, obs
-from .admission import AdmissionControl
+from .admission import AdmissionControl, TENANT_HEADER
 
 log = logging.getLogger(__name__)
 #: Dedicated child logger for the per-span trace lines, so ``sdad --trace``
@@ -113,6 +113,17 @@ _ID_RE = re.compile(_ID)
 #: Charset a client-supplied X-Request-Id must satisfy to be echoed back
 #: (response-header injection hygiene).
 _REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._-]+")
+
+
+def _schedules_report(server) -> Optional[dict]:
+    """The ``/statusz`` schedules block (lazy import: the service plane
+    only loads when a scrape actually asks for it)."""
+    from ..service.scheduler import schedules_report
+
+    try:
+        return schedules_report(server)
+    except Exception:  # a third-party store without schedule support
+        return None
 
 
 def route_label(method: str, path: str) -> str:
@@ -319,6 +330,17 @@ class _Handler(BaseHTTPRequestHandler):
             return str(creds[0])
         return str(self.client_address[0])
 
+    def _tenant_key(self) -> Optional[str]:
+        """Per-tenant admission key: the CLAIMED recipient id from the
+        ``X-SDA-Tenant`` header (unverified, same trust model as the
+        agent key), token charset + bounded length so a hostile value
+        cannot grow the bucket dict with junk or smuggle bytes."""
+        claimed = self.headers.get(TENANT_HEADER, "")
+        if claimed and len(claimed) <= 64 \
+                and _REQUEST_ID_RE.fullmatch(claimed):
+            return claimed
+        return None
+
     # -- dispatch ----------------------------------------------------------
     def _route(self, method: str):
         self._t0 = time.perf_counter()
@@ -409,7 +431,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # refills.
                 admission = getattr(self.server, "admission", None)
                 if admission is not None and admission.enabled:
-                    shed = admission.admit(self._agent_key())
+                    shed = admission.admit(self._agent_key(),
+                                           tenant_key=self._tenant_key())
                     if shed is not None:
                         log.debug("%s %s -> %d shed (%s, retry in %.3fs)",
                                   method, path, shed.status, shed.reason,
@@ -691,6 +714,8 @@ class SdaHttpServer:
         max_inflight: Optional[int] = None,
         rate_limit: Optional[float] = None,
         rate_burst: float = 8.0,
+        tenant_rate: Optional[float] = None,
+        tenant_burst: float = 32.0,
         metrics_endpoint: bool = False,
         statusz_endpoint: bool = False,
         trace_log: bool = False,
@@ -713,7 +738,8 @@ class SdaHttpServer:
         if fleet_peers is not None:
             metrics.gauge_set("fleet.peers", fleet_peers)
         self.admission = AdmissionControl(
-            max_inflight=max_inflight, rate=rate_limit, burst=rate_burst
+            max_inflight=max_inflight, rate=rate_limit, burst=rate_burst,
+            tenant_rate=tenant_rate, tenant_burst=tenant_burst,
         )
         self.httpd.admission = self.admission  # type: ignore[attr-defined]
         self.httpd.metrics_enabled = metrics_endpoint  # type: ignore[attr-defined]
@@ -746,6 +772,12 @@ class SdaHttpServer:
             "inflight": gauges.get("http.inflight", 0),
             "inflight_peak": gauges.get("http.inflight.peak", 0),
             "admission_enabled": self.admission.enabled,
+            # multi-tenant fairness verdicts (http/admission.py): which
+            # tenants were admitted/shed against their own budgets —
+            # present only when the per-tenant layer is armed
+            "admission": (self.admission.tenants_report()
+                          if self.admission.tenant_rate is not None
+                          else None),
             "requests": self.status_counts,
             # which wire the peers actually spoke (fleet loadgen reads
             # the negotiated outcome from here — the counters live in
@@ -763,11 +795,15 @@ class SdaHttpServer:
             # across scrapes — the counters live in THIS process)
             "participation": metrics.counter_report(
                 "server.participation.") or {},
-            # round lifecycle table (server/lifecycle.py): per-state
-            # tallies + the most recently updated rounds with their
-            # terminal diagnoses — the fleet's shared-store view, so any
-            # worker's scrape shows every round
+            # round lifecycle table (server/lifecycle.py): per-state and
+            # per-tenant tallies + the most recently updated LIVE rounds
+            # (terminal history only pads the remainder) — the fleet's
+            # shared-store view, so any worker's scrape shows every round
             "rounds": _lifecycle.rounds_report(service.server),
+            # recurring-round schedules (service/scheduler.py): every
+            # installed schedule's tenant, current epoch and cadence —
+            # also the shared-store view
+            "schedules": _schedules_report(service.server),
             # live fleet health table (server/health.py): every worker's
             # heartbeat state and age, read from the shared store — any
             # worker's scrape shows the whole fleet
@@ -790,11 +826,14 @@ class SdaHttpServer:
         max_inflight: Optional[int] = None,
         rate_limit: Optional[float] = None,
         rate_burst: Optional[float] = None,
+        tenant_rate: Optional[float] = None,
+        tenant_burst: Optional[float] = None,
     ) -> None:
         """Retune (or disable, with all-``None``) admission at runtime —
         the loadgen driver arms overload profiles only after round setup."""
         self.admission.configure(
-            max_inflight=max_inflight, rate=rate_limit, burst=rate_burst
+            max_inflight=max_inflight, rate=rate_limit, burst=rate_burst,
+            tenant_rate=tenant_rate, tenant_burst=tenant_burst,
         )
 
     @property

@@ -71,6 +71,11 @@ class LoadProfile:
     max_inflight: Optional[int] = None
     rate_limit: Optional[float] = None   # per-agent tokens/sec
     rate_burst: float = 4.0
+    # per-tenant fairness budget (http/admission.py): the swarm stamps
+    # the aggregation's recipient as X-SDA-Tenant, so a hot tenant sheds
+    # against its own budget before touching the shared caps
+    tenant_rate: Optional[float] = None
+    tenant_burst: float = 32.0
     # combined load+chaos drill: fraction of requests to 500 (0 = off)
     chaos_rate: float = 0.0
     # device churn under load (chaos.churn_schedule): this seeded fraction
@@ -206,6 +211,9 @@ def run_load(profile: LoadProfile) -> dict:
         if profile.rate_limit is not None:
             extra += ["--rate-limit", str(profile.rate_limit),
                       "--rate-burst", str(profile.rate_burst)]
+        if profile.tenant_rate is not None:
+            extra += ["--tenant-rate", str(profile.tenant_rate),
+                      "--tenant-burst", str(profile.tenant_burst)]
         if profile.max_inflight is not None:
             extra += ["--max-inflight", str(profile.max_inflight)]
         if profile.chaos_rate > 0.0:
@@ -311,6 +319,14 @@ def run_load(profile: LoadProfile) -> dict:
                 # the round's control plane (snapshot POST, status polls,
                 # reveal) rides the aggregation's affinity node from here
                 recipient.service = _proxy_for(agg.id)
+            # the whole swarm belongs to ONE tenant — the aggregation's
+            # recipient; stamping it arms the per-tenant budget bucket
+            # when tenant_rate is set (and is harmless otherwise)
+            if fleet is not None:
+                for proxy in node_proxies.values():
+                    proxy.tenant = str(recipient.agent.id)
+            else:
+                single_proxy.tenant = str(recipient.agent.id)
             recipient.upload_aggregation(agg)
             recipient.begin_aggregation(agg.id)
             committee = recipient.service.get_committee(recipient.agent, agg.id)
@@ -324,6 +340,8 @@ def run_load(profile: LoadProfile) -> dict:
                     max_inflight=profile.max_inflight,
                     rate_limit=profile.rate_limit,
                     rate_burst=profile.rate_burst,
+                    tenant_rate=profile.tenant_rate,
+                    tenant_burst=profile.tenant_burst,
                 )
                 if profile.chaos_rate > 0.0:
                     chaos.configure("http.server.request", error=True,
@@ -596,6 +614,9 @@ def run_load(profile: LoadProfile) -> dict:
             "max_inflight": profile.max_inflight,
             "rate_limit": profile.rate_limit,
             "rate_burst": profile.rate_burst,
+            "tenant_rate": profile.tenant_rate,
+            "tenant_burst": (profile.tenant_burst
+                             if profile.tenant_rate is not None else None),
         },
         "completed": completed,
         "client_failures": len(failures),

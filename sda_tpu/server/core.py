@@ -107,6 +107,11 @@ class SdaServer:
         #: expires anything — arm via sdad --round-collect-deadline /
         #: --round-clerk-deadline and sweep with --round-sweep
         self.round_deadlines = lifecycle.RoundDeadlines()
+        #: retention policy for terminal rounds (service/retention.py);
+        #: None keeps every revealed/failed round forever (the
+        #: pre-service behavior) — arm via sdad --retain-revealed /
+        #: --retain-failed and sweep with --round-sweep
+        self.retention_policy = None
 
     # -- health ------------------------------------------------------------
     def ping(self) -> Pong:
@@ -150,7 +155,30 @@ class SdaServer:
         lifecycle.note_collecting(self, aggregation)
 
     def delete_aggregation(self, aggregation: AggregationId) -> None:
+        """Full cascade, not just the aggregation doc: every artifact the
+        round ever produced leaves both stores (the aggregation store's
+        own cascade covers round doc, participations + owner markers,
+        snapshots, freezes and mask chunks; the clerking-job store purge
+        covers jobs, leases and results per snapshot). Retention
+        (service/retention.py) depends on this being a FULL purge — a
+        long-running service deleting revealed rounds must leave store
+        size flat, not leak job payloads forever."""
+        self.purge_aggregation(aggregation)
+
+    def purge_aggregation(self, aggregation: AggregationId) -> dict:
+        """The delete/retention cascade; returns ``{"snapshots", "jobs"}``
+        tallies (jobs = clerking jobs + results removed). Idempotent —
+        purging an unknown or already-purged aggregation removes
+        nothing."""
+        snapshots = self.aggregation_store.list_snapshots(aggregation)
+        jobs = 0
+        for snapshot_id in snapshots:
+            jobs += int(self.clerking_job_store.purge_snapshot_jobs(
+                snapshot_id) or 0)
         self.aggregation_store.delete_aggregation(aggregation)
+        if jobs:
+            metrics.count("server.purge.jobs", jobs)
+        return {"snapshots": len(snapshots), "jobs": jobs}
 
     def suggest_committee(self, aggregation: AggregationId) -> List[ClerkCandidate]:
         if self.aggregation_store.get_aggregation(aggregation) is None:

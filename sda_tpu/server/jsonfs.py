@@ -431,6 +431,41 @@ class JsonAggregationsStore(_FsStore, AggregationsStore):
             _write_json(path, doc)
             return True
 
+    # -- recurring-round schedules -------------------------------------------
+    def create_schedule_state(self, doc):
+        # create-if-absent via link(2): installation is single-winner
+        # across OS processes, so a booting scheduler can never reset an
+        # advanced schedule (stores.py schedule contract)
+        with self._lock:
+            return _write_json_new(
+                self.root / "schedules" / f"{doc['schedule']}.json", doc)
+
+    def get_schedule_state(self, schedule):
+        with self._lock:
+            return _read_json(self.root / "schedules" / f"{schedule}.json")
+
+    def list_schedule_states(self):
+        with self._lock:
+            out = []
+            for name in _ids_in(self.root / "schedules"):
+                doc = _read_json(self.root / "schedules" / f"{name}.json")
+                if doc is not None:
+                    out.append(doc)
+            return out
+
+    def transition_schedule_state(self, schedule, from_epoch, doc):
+        # single-winner epoch CAS across fleet worker processes: the dir
+        # flock makes the read-check-write atomic (same shape as
+        # transition_round_state)
+        with self._lock, self._dir_lock(self.root / "schedules"):
+            path = self.root / "schedules" / f"{schedule}.json"
+            current = _read_json(path)
+            if current is None \
+                    or int(current.get("epoch", -1)) != int(from_epoch):
+                return False
+            _write_json(path, doc)
+            return True
+
     def create_snapshot_mask(self, snapshot, mask):
         self.put_snapshot_mask_chunk(snapshot, 0, mask)
         self.trim_snapshot_mask_chunks(snapshot, 1)
@@ -690,6 +725,39 @@ class JsonClerkingJobsStore(_FsStore, ClerkingJobsStore):
             _write_json(self.root / "done" / str(result.clerk) / f"{job.id}.json", obj)
             queue_path.unlink(missing_ok=True)
             queue_path.with_name(f".lease-{result.job}.json").unlink(missing_ok=True)
+
+    def purge_snapshot_jobs(self, snapshot):
+        # the retention/delete cascade's job-store half: walk both queue
+        # trees removing the snapshot's job files (and their dot-lease
+        # files), then drop the whole results directory. Per-clerk dirs
+        # are purged under their flock — the same arbitration the
+        # grant/commit paths take, so a racing poll serializes cleanly
+        import shutil
+
+        removed = 0
+        with self._lock:
+            for sub in ("queue", "done"):
+                base = self.root / sub
+                if not base.is_dir():
+                    continue
+                for clerk_dir in sorted(p for p in base.iterdir()
+                                        if p.is_dir()):
+                    with self._dir_lock(clerk_dir):
+                        for job_id in _ids_in(clerk_dir):
+                            obj = _read_json(clerk_dir / f"{job_id}.json")
+                            if obj is None \
+                                    or obj.get("snapshot") != str(snapshot):
+                                continue
+                            (clerk_dir / f"{job_id}.json").unlink(
+                                missing_ok=True)
+                            (clerk_dir / f".lease-{job_id}.json").unlink(
+                                missing_ok=True)
+                            removed += 1
+            results_dir = self.root / "results" / str(snapshot)
+            if results_dir.is_dir():
+                removed += len(_ids_in(results_dir))
+                shutil.rmtree(results_dir, ignore_errors=True)
+        return removed
 
     def list_results(self, snapshot):
         with self._lock:

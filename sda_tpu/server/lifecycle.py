@@ -117,6 +117,10 @@ def new_round_doc(aggregation, deadlines: Optional[RoundDeadlines]) -> dict:
     tree = getattr(aggregation, "tree", None)
     return {
         "aggregation": str(aggregation.id),
+        # tenant = the aggregation's recipient: the multi-tenant service
+        # plane rolls /statusz up per recipient and retention reports
+        # name the tenant whose round was purged (service/)
+        "tenant": str(aggregation.recipient),
         "state": "collecting",
         "snapshot": None,
         "scheme": scheme_kind(scheme),
@@ -293,22 +297,48 @@ def round_status(server, aggregation_id) -> Optional[RoundStatus]:
 
 
 def rounds_report(server, limit: int = 16) -> dict:
-    """The ``/statusz`` rounds table: per-state tallies plus the most
-    recently updated rounds (bounded — a long-lived server accumulates
-    terminal rounds)."""
+    """The ``/statusz`` rounds table, built for LONG-LIVED services: a
+    thousand-round deployment is mostly terminal history, and the rounds
+    an operator needs are the live ones. The ``recent`` table therefore
+    fills with live (non-terminal) rounds first, most recently updated
+    first, and only pads the remainder with terminal rounds — and the
+    output stays O(limit) regardless of how many rounds the store holds.
+    ``by_tenant`` is the multi-tenant rollup (state counts per recipient,
+    bounded to the ``limit`` busiest tenants; ``tenants_omitted`` says
+    how many fell off)."""
     docs = server.aggregation_store.list_round_states()
     by_state: dict = {}
+    by_tenant: dict = {}
+    live = 0
     for doc in docs:
-        by_state[doc.get("state", "?")] = by_state.get(doc.get("state", "?"),
-                                                       0) + 1
-    recent = sorted(docs, key=lambda d: d.get("updated_at") or 0.0,
-                    reverse=True)[:limit]
+        state = doc.get("state", "?")
+        by_state[state] = by_state.get(state, 0) + 1
+        if state not in TERMINAL_STATES:
+            live += 1
+        tenant = doc.get("tenant") or "?"
+        by_tenant.setdefault(tenant, {})[state] = (
+            by_tenant.get(tenant, {}).get(state, 0) + 1)
+    freshest = sorted(docs, key=lambda d: d.get("updated_at") or 0.0,
+                      reverse=True)
+    recent = [d for d in freshest
+              if d.get("state") not in TERMINAL_STATES][:limit]
+    if len(recent) < limit:
+        recent += [d for d in freshest
+                   if d.get("state") in TERMINAL_STATES
+                   ][:limit - len(recent)]
+    tenants = sorted(by_tenant.items(),
+                     key=lambda kv: (-sum(kv[1].values()), kv[0]))
     return {
         "count": len(docs),
+        "live": live,
         "by_state": dict(sorted(by_state.items())),
+        "by_tenant": {tenant: dict(sorted(states.items()))
+                      for tenant, states in tenants[:limit]},
+        "tenants_omitted": max(0, len(tenants) - limit),
         "recent": [
             {
                 "aggregation": d.get("aggregation"),
+                "tenant": d.get("tenant"),
                 "state": d.get("state"),
                 "snapshot": d.get("snapshot"),
                 "reason": d.get("reason"),
@@ -425,6 +455,16 @@ class RoundSweeper:
             # above just declared failed/expired fails its ancestors in
             # the SAME sweep (no extra tick of latency)
             actions.extend(self._sweep_tree(docs))
+            # retention LAST: a round the diagnosis above just made
+            # terminal starts its TTL clock now; rounds whose TTL lapsed
+            # are expired (CAS) and cascade-purged from every backend
+            # (service/retention.py; armed via server.retention_policy)
+            policy = getattr(self.server, "retention_policy", None)
+            if policy is not None and policy.enabled:
+                from ..service import retention
+
+                actions.extend(retention.sweep_retention(
+                    self.server, docs, now=now))
             sweep_span.set_attribute("rounds", len(docs))
             sweep_span.set_attribute("actions", len(actions))
         metrics.observe("server.round.sweep", time.perf_counter() - t0)

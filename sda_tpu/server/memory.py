@@ -122,6 +122,7 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
         self._snapshot_parts: Dict[SnapshotId, List[ParticipationId]] = {}
         self._snapshot_masks = {}
         self._rounds: Dict[str, dict] = {}  # aggregation id str -> doc
+        self._schedules: Dict[str, dict] = {}  # schedule name -> doc
 
     def list_aggregations(self, filter=None, recipient=None):
         with self._lock:
@@ -268,6 +269,38 @@ class MemoryAggregationsStore(_Locked, AggregationsStore):
             if current is None or current.get("state") not in from_states:
                 return False
             self._rounds[str(aggregation)] = dict(doc)
+            return True
+
+    # -- recurring-round schedules -------------------------------------------
+    def create_schedule_state(self, doc):
+        # conditional insert under the store lock: installation is
+        # single-winner, a booting scheduler can never reset an advanced
+        # schedule (stores.py schedule contract)
+        with self._lock:
+            if doc["schedule"] in self._schedules:
+                return False
+            self._schedules[doc["schedule"]] = dict(doc)
+            return True
+
+    def get_schedule_state(self, schedule):
+        with self._lock:
+            doc = self._schedules.get(str(schedule))
+            return None if doc is None else dict(doc)
+
+    def list_schedule_states(self):
+        with self._lock:
+            return [dict(d) for d in self._schedules.values()]
+
+    def transition_schedule_state(self, schedule, from_epoch, doc):
+        # single-winner epoch CAS: the epoch check + publish under one
+        # lock hold is the arbiter (same contract the sqlite/jsonfs/mongo
+        # stores keep across OS processes)
+        with self._lock:
+            current = self._schedules.get(str(schedule))
+            if current is None \
+                    or int(current.get("epoch", -1)) != int(from_epoch):
+                return False
+            self._schedules[str(schedule)] = dict(doc)
             return True
 
     def create_snapshot_mask(self, snapshot, mask):
@@ -457,6 +490,26 @@ class MemoryClerkingJobsStore(_Locked, ClerkingJobsStore):
                 self._lease_owners.pop(job.id, None)
                 self._done.setdefault(result.clerk, {})[job.id] = job
                 self._results.setdefault(job.snapshot, OrderedDict())[result.job] = result
+
+    def purge_snapshot_jobs(self, snapshot):
+        # the retention/delete cascade's job-store half: queued AND done
+        # jobs of the snapshot leave, with their leases and results —
+        # nothing the round ever produced survives the purge
+        with self._lock:
+            removed = 0
+            for table in (self._queues, self._done):
+                for clerk in list(table):
+                    jobs = table[clerk]
+                    for job_id in [jid for jid, job in jobs.items()
+                                   if str(job.snapshot) == str(snapshot)]:
+                        del jobs[job_id]
+                        self._leases.pop(job_id, None)
+                        self._lease_owners.pop(job_id, None)
+                        removed += 1
+                    if not jobs:
+                        del table[clerk]
+            removed += len(self._results.pop(snapshot, OrderedDict()))
+            return removed
 
     def list_results(self, snapshot):
         with self._lock:

@@ -423,6 +423,35 @@ class MongoAggregationsStore(_MongoStore, AggregationsStore):
         )
         return found is not None
 
+    # -- recurring-round schedules -------------------------------------------
+    def create_schedule_state(self, doc):
+        # conditional insert via $setOnInsert upsert — Mongo's atomic
+        # create-if-absent; installation is single-winner, so a booting
+        # scheduler can never reset an advanced schedule
+        result = self.db.schedules.update_one(
+            {"_id": doc["schedule"]},
+            {"$setOnInsert": {"_id": doc["schedule"],
+                              "epoch": int(doc["epoch"]), "doc": doc}},
+            upsert=True,
+        )
+        return result.upserted_id is not None
+
+    def get_schedule_state(self, schedule):
+        found = self.db.schedules.find_one({"_id": str(schedule)})
+        return None if found is None else found["doc"]
+
+    def list_schedule_states(self):
+        return [d["doc"] for d in self.db.schedules.find({}).sort("_id", 1)]
+
+    def transition_schedule_state(self, schedule, from_epoch, doc):
+        # single-winner epoch CAS: one atomic find_one_and_update keyed
+        # on the FROM epoch (same shape as transition_round_state)
+        found = self.db.schedules.find_one_and_update(
+            {"_id": str(schedule), "epoch": int(from_epoch)},
+            {"$set": {"epoch": int(doc["epoch"]), "doc": doc}},
+        )
+        return found is not None
+
     def create_snapshot_mask(self, snapshot, mask):
         self.put_snapshot_mask_chunk(snapshot, 0, mask)
         self.trim_snapshot_mask_chunks(snapshot, 1)
@@ -655,6 +684,18 @@ class MongoClerkingJobsStore(_MongoStore, ClerkingJobsStore):
             if already is not None and already.get("done"):
                 return  # duplicate result upload: idempotent
             raise NotFound("job not found for clerk")
+
+    def purge_snapshot_jobs(self, snapshot):
+        # the retention/delete cascade's job-store half: job docs carry
+        # their result embedded (post-atomic-fix schema), so one
+        # delete_many covers jobs + leases + results; the legacy results
+        # collection is swept for pre-fix data
+        jobs = self.db.clerking_jobs.delete_many(
+            {"snapshot": str(snapshot)})
+        legacy = self.db.clerking_results.delete_many(
+            {"snapshot": str(snapshot)})
+        return (int(getattr(jobs, "deleted_count", 0) or 0)
+                + int(getattr(legacy, "deleted_count", 0) or 0))
 
     def list_results(self, snapshot):
         ids = {

@@ -111,6 +111,8 @@ CREATE TABLE IF NOT EXISTS clerking_results (
     PRIMARY KEY (snapshot, job));
 CREATE TABLE IF NOT EXISTS rounds (
     aggregation TEXT PRIMARY KEY, state TEXT NOT NULL, doc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS schedules (
+    schedule TEXT PRIMARY KEY, epoch INTEGER NOT NULL, doc TEXT NOT NULL);
 CREATE TABLE IF NOT EXISTS worker_heartbeats (
     node TEXT PRIMARY KEY, state TEXT NOT NULL, doc TEXT NOT NULL);
 """
@@ -603,6 +605,40 @@ class SqliteAggregationsStore(_SqliteStore, AggregationsStore):
         )
         return cursor.rowcount > 0
 
+    # -- recurring-round schedules -------------------------------------------
+    def create_schedule_state(self, doc):
+        # conditional insert (single-winner across OS processes): OR
+        # IGNORE + rowcount, same arbitration shape as create_snapshot —
+        # a booting scheduler can never reset an advanced schedule
+        cursor = self._exec(
+            "INSERT OR IGNORE INTO schedules (schedule, epoch, doc) "
+            "VALUES (?, ?, ?)",
+            (doc["schedule"], int(doc["epoch"]), json.dumps(doc)),
+        )
+        return cursor.rowcount > 0
+
+    def get_schedule_state(self, schedule):
+        row = self._one(
+            "SELECT doc FROM schedules WHERE schedule = ?", (str(schedule),)
+        )
+        return None if row is None else json.loads(row[0])
+
+    def list_schedule_states(self):
+        rows = self._all("SELECT doc FROM schedules ORDER BY schedule")
+        return [json.loads(r[0]) for r in rows]
+
+    def transition_schedule_state(self, schedule, from_epoch, doc):
+        # single-winner epoch CAS across OS processes: ONE conditional
+        # UPDATE keyed on the FROM epoch; rowcount says whether THIS
+        # scheduler's advance won (same shape as transition_round_state)
+        cursor = self._exec(
+            "UPDATE schedules SET epoch = ?, doc = ? "
+            "WHERE schedule = ? AND epoch = ?",
+            (int(doc["epoch"]), json.dumps(doc), str(schedule),
+             int(from_epoch)),
+        )
+        return cursor.rowcount > 0
+
     def create_snapshot_mask(self, snapshot, mask):
         self.put_snapshot_mask_chunk(snapshot, 0, mask)
         self.trim_snapshot_mask_chunks(snapshot, 1)
@@ -864,6 +900,21 @@ class SqliteClerkingJobsStore(_SqliteStore, ClerkingJobsStore):
                 "UPDATE clerking_jobs SET done = 1 WHERE clerk = ? AND id = ?",
                 (str(result.clerk), str(result.job)),
             )
+
+    def purge_snapshot_jobs(self, snapshot):
+        # the retention/delete cascade's job-store half: jobs (queued and
+        # done, leases riding the rows) and results of the snapshot leave
+        # in one transaction
+        with self.db.immediate():
+            jobs = self.db.conn.execute(
+                "DELETE FROM clerking_jobs WHERE snapshot = ?",
+                (str(snapshot),),
+            ).rowcount
+            results = self.db.conn.execute(
+                "DELETE FROM clerking_results WHERE snapshot = ?",
+                (str(snapshot),),
+            ).rowcount
+        return max(0, jobs) + max(0, results)
 
     def list_results(self, snapshot):
         rows = self._all(

@@ -279,6 +279,57 @@ class AggregationsStore(BaseStore):
     @abc.abstractmethod
     def get_snapshot_mask(self, snapshot: SnapshotId) -> Optional[List[Encryption]]: ...
 
+    # -- recurring-round schedules (service/scheduler.py) -------------------
+    # One document per ScheduleSpec, keyed by ``doc["schedule"]`` and
+    # carrying the schedule's current epoch number. The scheduler plane
+    # uses two conditional writes — create-if-absent installation and an
+    # epoch-keyed CAS advance — so a fleet of schedulers mints each epoch
+    # exactly once (the same single-winner discipline as
+    # ``transition_round_state``). The four in-repo backends override
+    # with durable, contended-safe implementations; the base fallbacks
+    # keep third-party stores working (in-memory, NOT crash- or
+    # fleet-safe).
+
+    def _fallback_schedules(self) -> dict:
+        schedules = getattr(self, "_base_schedules", None)
+        if schedules is None:
+            schedules = self._base_schedules = {}
+        return schedules
+
+    def create_schedule_state(self, doc: dict) -> bool:
+        """Conditional insert: record the schedule document iff none with
+        its ``doc["schedule"]`` name exists yet; returns whether THIS
+        call installed it. Installation must be single-winner so a fleet
+        of schedulers booting against one shared store cannot reset a
+        schedule that already advanced past epoch 0."""
+        schedules = self._fallback_schedules()
+        if doc["schedule"] in schedules:
+            return False
+        schedules[doc["schedule"]] = dict(doc)
+        return True
+
+    def get_schedule_state(self, schedule: str) -> Optional[dict]:
+        doc = self._fallback_schedules().get(str(schedule))
+        return None if doc is None else dict(doc)
+
+    def list_schedule_states(self) -> List[dict]:
+        return [dict(d) for d in self._fallback_schedules().values()]
+
+    def transition_schedule_state(
+        self, schedule: str, from_epoch: int, doc: dict
+    ) -> bool:
+        """Single-winner epoch advance: install ``doc`` iff the stored
+        document's current ``epoch`` equals ``from_epoch``. N racing
+        scheduler workers CAS epoch e -> e+1; exactly one wins and mints
+        the epoch's aggregation, the losers observe the winner's advance
+        and converge (service/scheduler.py)."""
+        schedules = self._fallback_schedules()
+        current = schedules.get(str(schedule))
+        if current is None or int(current.get("epoch", -1)) != int(from_epoch):
+            return False
+        schedules[str(schedule)] = dict(doc)
+        return True
+
     # -- round lifecycle (server/lifecycle.py) ------------------------------
     # The four in-repo backends override all of these with durable,
     # contended-safe implementations; the base fallbacks below keep
@@ -466,6 +517,19 @@ class ClerkingJobsStore(BaseStore):
         backends without lease support. The base fallback returns ``[]``
         (no census possible → the sweeper stays silent)."""
         return []
+
+    def purge_snapshot_jobs(self, snapshot: SnapshotId) -> int:
+        """Remove EVERY clerking job, lease, and result of ``snapshot`` —
+        the job-store half of the aggregation delete/retention cascade
+        (``SdaServer.purge_aggregation``): a long-running service expires
+        revealed rounds and their artifacts must actually leave all four
+        backends, or fleet memory and store size grow forever
+        (service/retention.py). Idempotent: purging an already-purged
+        snapshot removes nothing. Returns how many documents (jobs +
+        results) were removed; 0 on backends without purge support (the
+        base fallback — artifacts then leak, as pre-retention stores
+        always did)."""
+        return 0
 
     @abc.abstractmethod
     def list_results(self, snapshot: SnapshotId) -> List[ClerkingJobId]: ...

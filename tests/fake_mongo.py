@@ -72,6 +72,11 @@ def _apply_update(doc: Dict[str, Any], update: Dict[str, Any]) -> None:
             raise NotImplementedError(f"fake_mongo: update op {op}")
 
 
+class _DeleteResult:
+    def __init__(self, deleted_count: int):
+        self.deleted_count = deleted_count
+
+
 class _UpdateResult:
     def __init__(self, matched_count: int, upserted_id: Any = None):
         self.matched_count = matched_count
@@ -145,13 +150,17 @@ class FakeCollection:
 
     def delete_one(self, query: Dict[str, Any]):
         with self._lock:
-            for doc in self._find(query)[:1]:
+            found = self._find(query)[:1]
+            for doc in found:
                 del self._docs[doc["_id"]]
+            return _DeleteResult(len(found))
 
     def delete_many(self, query: Dict[str, Any]):
         with self._lock:
-            for doc in self._find(query):
+            found = self._find(query)
+            for doc in found:
                 del self._docs[doc["_id"]]
+            return _DeleteResult(len(found))
 
     def update_many(self, query: Dict[str, Any], update: Dict[str, Any]):
         with self._lock:
