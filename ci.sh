@@ -299,6 +299,59 @@ PY
 python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$SOAK_RECORD"
 rm -f "$SOAK_RECORD"
 
+echo "== FL drill (fixed seed: LeNet secure FedAvg, 8 devices, ~25% churn, 1 dead clerk, sqlite+HTTP; target accuracy reached, bit-exact aggregate every round)"
+FL_RECORD=$(mktemp /tmp/sda-fl-XXXX.json)
+FL=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --fl --participants 8 \
+  --fl-family lenet --fl-rounds 3 --fl-local-steps 6 --fl-batch 32 \
+  --fl-target 0.8 --fl-churn 0.25 --fl-dead-clerks 1 \
+  --fl-store sqlite --fl-http --fl-seed 20260803)
+FL="$FL" FL_RECORD="$FL_RECORD" python - <<'PY'
+import json, os
+report = json.loads(os.environ["FL"].strip().splitlines()[-1])
+# the canonical-workload verdict: R secure FedAvg rounds over the real
+# stack reach the target accuracy, and EVERY revealed round is bit-exact
+# vs the plaintext quantized sum of its frozen participant set — under
+# nonzero device dropout AND a permanently dead committee clerk
+assert report["exact"] is True, report["failure_samples"]
+assert report["rounds_exact"] == report["rounds_run"] == 3, report
+assert report["reached_target"] is True, report["accuracy_by_round"]
+assert report["rounds_to_target"] <= 3, report
+assert report["final_accuracy"] >= report["target_accuracy"], report
+# the real (shrunk) LeNet trained and shipped: 61k-dim encoded deltas
+assert report["family"] == "lenet" and report["dim"] > 60000, report
+# availability churn actually happened and resolved exactly-once: every
+# departure resumed via its journal, mid-upload crashes replayed
+# byte-identically, pre-upload crashes ARE the rounds' dropout
+churn = report["churn"]
+assert churn["participants_churned"] >= 1, churn
+assert churn["participants_resumed"] == churn["participants_churned"], churn
+assert churn["participations_replayed"] >= 1, churn
+assert churn["dropped_from_rounds"] >= 1, churn
+assert any(r["dropped"] >= 1 for r in report["per_round"]), report["per_round"]
+# the dead clerk degraded every round through the lifecycle plane — and
+# the surviving Shamir quorum still revealed (never hung, never failed)
+assert report["degraded_rounds"] == 3, report
+assert all(r["state"] == "revealed" for r in report["per_round"]), report
+assert report["leaks"] == 0 and report["client_failures"] == 0, report
+with open(os.environ["FL_RECORD"], "w") as f:
+    json.dump(report, f)
+acc = "->".join(str(a) for a in report["accuracy_by_round"])
+print(f"FL drill OK: accuracy {acc} (target {report['target_accuracy']} in "
+      f"{report['rounds_to_target']} round(s)), {report['rounds_exact']}/3 "
+      f"bit-exact, {churn['participants_churned']} churned/"
+      f"{churn['participants_resumed']} resumed/"
+      f"{churn['participations_replayed']} replayed, "
+      f"{report['degraded_rounds']} degraded round(s)")
+PY
+# the accuracy-vs-rounds record (direction=lower: MORE rounds to target
+# is the regression) must parse as a bench record and gate advisory via
+# sda-bench --check (first record of its metric seeds the window)
+python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$FL_RECORD"
+rm -f "$FL_RECORD"
+# the participate-input micro-bench behind the ndarray pass-through fix:
+# one vectorized normalization at model dim instead of 1e5 int() calls
+python -m sda_tpu.loadgen.inputbench --dim 100000
+
 echo "== trace smoke (fixed seed: Chrome-trace export, one connected round trace, bit-exact)"
 TRACE_OUT=$(mktemp /tmp/sda-trace-XXXX.json)
 TRACE_REPORT=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 12 --dim 4 \

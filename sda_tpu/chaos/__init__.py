@@ -371,7 +371,8 @@ def reset() -> None:
     registry.clear()
 
 
-def churn_schedule(agents: int, rate: float, seed: int = 0) -> list:
+def churn_schedule(agents: int, rate: float, seed: int = 0,
+                   epoch: Optional[int] = None) -> list:
     """Seeded per-agent device-churn plan — the sporadic-device model
     (PAPER.md's weak phones) made deterministic, the same ``(seed, name)``
     RNG discipline every failpoint keeps.
@@ -395,10 +396,17 @@ def churn_schedule(agents: int, rate: float, seed: int = 0) -> list:
     and composes freely with this plan. Drills iterate the plan; the
     drill, not this schedule, performs the crash/rejoin, which keeps the
     plan reusable by both ``sda-sim --chaos --churn`` and the loadgen
-    churn knob (docs/robustness.md)."""
+    churn knob (docs/robustness.md).
+
+    ``epoch`` folds a round/epoch index into the RNG key, so a recurring
+    workload (the FL scenario's R rounds, a soak's epochs) gets an
+    independent-but-reproducible availability plan per round from ONE
+    seed — who is offline in round 3 does not depend on who was offline
+    in round 2, but both replay exactly."""
     if not 0.0 <= rate <= 1.0:
         raise ValueError(f"churn rate {rate} outside [0, 1]")
-    rng = random.Random(f"{seed}:churn")
+    key = f"{seed}:churn" if epoch is None else f"{seed}:churn:{int(epoch)}"
+    rng = random.Random(key)
     plan = []
     departures = 0
     for index in range(agents):

@@ -9,14 +9,19 @@ line with timing and the verification verdict.
     sda-sim --participants 100 --dim 9999 --clerks 8
     sda-sim --participants 1000 --dim 3000000 --streaming
 
-Three no-JAX drill profiles exercise the serving plane instead of the
+Four no-JAX drill profiles exercise the serving plane instead of the
 kernels: ``--chaos`` (fault injection, chaos/drill.py), ``--load``
-(capacity measurement + admission control, loadgen/driver.py), and
-``--tree`` (hierarchical population-scale rounds, sda_tpu/tree):
+(capacity measurement + admission control, loadgen/driver.py),
+``--tree`` (hierarchical population-scale rounds, sda_tpu/tree) and
+``--soak`` (continuous multi-tenant service, sda_tpu/service) — and the
+``--fl`` profile runs the federated-learning scenario suite (secure
+FedAvg end-to-end over the full substrate, sda_tpu/fl; this one DOES
+use jax for local training):
 
     sda-sim --load --participants 200 --load-rps 150
     sda-sim --load --participants 200 --load-overload
     sda-sim --tree --participants 24 --tree-dropout 0.1
+    sda-sim --fl --participants 8 --fl-family lenet --fl-churn 0.25
 """
 
 from __future__ import annotations
@@ -214,6 +219,88 @@ def build_parser() -> argparse.ArgumentParser:
                              "revealed round on the next sweep (--soak)")
     parser.add_argument("--soak-seed", type=int, default=0,
                         help="input/schedule/chaos seed (--soak)")
+    parser.add_argument("--fl", action="store_true",
+                        help="federated-learning profile: R rounds of "
+                             "secure FedAvg over the full substrate "
+                             "(sda_tpu/fl) — a seeded device population "
+                             "(--participants) with availability churn "
+                             "(journal + resume), local training, "
+                             "fixed-point encoding, scheduler-minted "
+                             "epochs, lifecycle-driven reveal with "
+                             "Shamir degradation on dead clerks, "
+                             "dropout-weighted global updates and an "
+                             "optional central-DP knob; prints the "
+                             "BENCH-style accuracy-vs-rounds record "
+                             "(docs/federated.md)")
+    parser.add_argument("--fl-family",
+                        choices=["linear", "lenet", "mobilelite", "lora"],
+                        default="linear",
+                        help="model family; linear is the fast smoke, "
+                             "lenet the 61k-param CI drill (--fl)")
+    parser.add_argument("--fl-rounds", type=int, metavar="R", default=3,
+                        help="FedAvg rounds = schedule epochs (--fl)")
+    parser.add_argument("--fl-local-steps", type=int, default=4,
+                        help="optimizer steps per device per round (--fl)")
+    parser.add_argument("--fl-batch", type=int, default=16,
+                        help="local minibatch size (--fl)")
+    parser.add_argument("--fl-shard", type=int, default=64,
+                        help="training examples per device (--fl)")
+    parser.add_argument("--fl-eval", type=int, default=256,
+                        help="held-out evaluation examples (--fl)")
+    parser.add_argument("--fl-lr", type=float, default=0.1,
+                        help="local SGD learning rate (--fl)")
+    parser.add_argument("--fl-target", type=float, metavar="ACC",
+                        default=0.8,
+                        help="target eval accuracy; the record's headline "
+                             "is rounds-to-target (--fl)")
+    parser.add_argument("--fl-churn", type=float, metavar="RATE",
+                        default=0.0,
+                        help="per-round device availability churn: this "
+                             "seeded fraction departs mid-round (seal + "
+                             "journal, crash pre- or mid-upload) and "
+                             "resumes next round; pre-upload departures "
+                             "ARE the round's dropout (--fl)")
+    parser.add_argument("--fl-dead-clerks", type=int, metavar="K",
+                        default=0,
+                        help="permanently kill K committee clerks: every "
+                             "round must degrade and still reveal "
+                             "bit-exactly from the surviving Shamir "
+                             "quorum (--fl)")
+    parser.add_argument("--fl-dp-sigma", type=float, metavar="S",
+                        default=0.0,
+                        help="central-DP noise multiplier on the revealed "
+                             "sum (0 = off); the report carries the "
+                             "composed zCDP/epsilon accounting (--fl)")
+    parser.add_argument("--fl-dp-delta", type=float, default=1e-5,
+                        help="delta for the epsilon conversion (--fl)")
+    parser.add_argument("--fl-store",
+                        choices=["memory", "sqlite", "jsonfs"],
+                        default="memory",
+                        help="server store backend for --fl")
+    parser.add_argument("--fl-http", action="store_true",
+                        help="drive devices over a real HTTP server "
+                             "instead of the in-process seam (--fl)")
+    parser.add_argument("--fl-fleet", type=int, metavar="N", default=0,
+                        help="drive the scenario against N real sdad "
+                             "worker processes over one shared "
+                             "sqlite/jsonfs store (--fl)")
+    parser.add_argument("--fl-chaos-rate", type=float, default=0.0,
+                        help="also 500 this fraction of requests (--fl)")
+    parser.add_argument("--fl-tree-group", type=int, metavar="G",
+                        default=0,
+                        help="population-scale mode: aggregate each round "
+                             "through sda_tpu/tree with G devices per "
+                             "leaf group (--fl)")
+    parser.add_argument("--fl-mnist", metavar="DIR", default=None,
+                        help="load MNIST-format IDX files from DIR "
+                             "instead of the seeded synthetic dataset "
+                             "(--fl; nothing is downloaded)")
+    parser.add_argument("--fl-clip", type=float, default=1.0,
+                        help="per-coordinate delta clip (--fl)")
+    parser.add_argument("--fl-modulus-bits", type=int, default=28,
+                        help="packed-Shamir sharing prime size (--fl)")
+    parser.add_argument("--fl-seed", type=int, default=0,
+                        help="data/shard/churn/DP seed (--fl)")
     parser.add_argument("--chaos", action="store_true",
                         help="robustness profile: run a full federated "
                              "round over real HTTP with deterministic "
@@ -603,6 +690,80 @@ def _run_soak(args) -> int:
     return 0 if ok else 1
 
 
+def _run_fl(args) -> int:
+    """--fl: the federated-learning scenario — R rounds of secure FedAvg
+    over the full substrate (sda_tpu/fl/scenario.py), reported as one
+    BENCH-style JSON line whose headline is rounds-to-target-accuracy.
+    Unlike the other drill profiles this one NEEDS jax (local training),
+    so the backend is pinned the same way the mesh modes pin it."""
+    import tempfile
+
+    from ..crypto import sodium
+    from ..utils.backend import select_platform, use_platform
+
+    if not sodium.available():
+        print("error: --fl needs libsodium (real-crypto federated rounds)",
+              file=sys.stderr)
+        return 1
+    # training runs under jit: never init the axon TPU backend in-process
+    # without a killable probe (same rule as the mesh modes)
+    use_platform(select_platform("SDA_SIM_PLATFORM"))
+    from ..fl import FLProfile, run_fl
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store = args.fl_store
+        if args.fl_fleet and store == "memory":
+            print("note: fleet mode needs a cross-process store; using "
+                  "--fl-store sqlite", file=sys.stderr)
+            store = "sqlite"
+        report = run_fl(FLProfile(
+            family=args.fl_family,
+            participants=args.participants,
+            rounds=args.fl_rounds,
+            local_steps=args.fl_local_steps,
+            batch_size=args.fl_batch,
+            shard_size=args.fl_shard,
+            eval_size=args.fl_eval,
+            lr=args.fl_lr,
+            target_accuracy=args.fl_target,
+            churn=args.fl_churn,
+            dead_clerks=args.fl_dead_clerks,
+            dp_sigma=args.fl_dp_sigma,
+            dp_delta=args.fl_dp_delta,
+            seed=args.fl_seed,
+            store=store,
+            store_path=None if store == "memory" else f"{tmp}/store",
+            http=args.fl_http,
+            fleet=args.fl_fleet,
+            chaos_rate=args.fl_chaos_rate,
+            tree_group_size=args.fl_tree_group,
+            dataset="mnist" if args.fl_mnist else "synthetic",
+            mnist_dir=args.fl_mnist,
+            clip=args.fl_clip,
+            modulus_bits=args.fl_modulus_bits,
+        ))
+    _export_trace(args, report)
+    print(json.dumps(report))
+    # the scenario verdict: every revealed round bit-exact vs the
+    # plaintext quantized sum of its frozen set, the accuracy target
+    # reached, nothing leaked or failed — and the failure modes the
+    # profile armed actually happened (churned devices all resumed,
+    # dead-clerk rounds degraded rather than hanging or failing)
+    ok = (report["exact"]
+          and report["reached_target"]
+          and report["client_failures"] == 0
+          and report.get("leaks", 0) == 0)
+    if args.fl_churn and not args.fl_tree_group:
+        churn = report["churn"]
+        ok = ok and (churn["participants_resumed"]
+                     == churn["participants_churned"])
+    if args.fl_dead_clerks:
+        ok = ok and report["degraded_rounds"] == report["rounds_run"]
+    if args.fl_fleet:
+        ok = ok and report["fleet"]["leaked"] == 0
+    return 0 if ok else 1
+
+
 def _run_chaos(args) -> int:
     """--chaos: the robustness drill — a full federated round over real
     HTTP under deterministic fault injection (sda_tpu/chaos/drill.py),
@@ -687,6 +848,8 @@ def main(argv=None) -> int:
 
     if args.load:
         return _run_load(args)
+    if args.fl:
+        return _run_fl(args)
     if args.soak:
         return _run_soak(args)
     if args.tree:

@@ -336,8 +336,23 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
 
         Separated from upload so a network failure can be retried without
         recomputation or double participation (participate.rs:16-19).
+
+        ``input`` may be any integer sequence OR an int ndarray — the
+        ndarray path is the hot one (a model-scale FL delta arrives as
+        the codec's int64 residue vector and is normalized in one
+        vectorized pass, no per-element conversion). Float arrays are
+        rejected rather than silently truncated: quantization is the
+        codec's job (``FixedPointCodec.encode``), and ``np.asarray(x,
+        int64)`` on raw floats would floor-toward-zero without the
+        clip/round/headroom contract.
         """
-        secrets = np.asarray(input, dtype=np.int64)
+        arr = input if isinstance(input, np.ndarray) else np.asarray(input)
+        if arr.size and np.issubdtype(arr.dtype, np.floating):
+            raise ValueError(
+                "participation input must be integers in [0, modulus); "
+                "encode float vectors through FixedPointCodec.encode "
+                "first (a raw float->int64 cast would truncate)")
+        secrets = np.asarray(arr, dtype=np.int64)
 
         aggregation = self._cached_aggregation(aggregation_id)
         if aggregation is None:

@@ -15,5 +15,10 @@ from .driver import (
     run_load,
 )
 
+# ``inputbench`` (the participation input-path micro-bench behind
+# ``python -m sda_tpu.loadgen.inputbench``) is intentionally NOT imported
+# eagerly: importing a ``-m`` target from its package __init__ trips
+# runpy's double-import warning. ``from sda_tpu.loadgen.inputbench import
+# run_input_bench`` for programmatic use.
 __all__ = ["LoadProfile", "latency_report_ms", "run_fleet_scaling",
            "run_load"]

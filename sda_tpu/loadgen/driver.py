@@ -547,20 +547,16 @@ def run_load(profile: LoadProfile) -> dict:
         # the codec counters are stamped server-side, i.e. in the worker
         # processes: merge their final scrapes so the negotiated-wire
         # field below names what the fleet actually spoke
-        merged_codec: dict = {}
-        for doc in final_scrapes.values():
-            for name, count in (doc.get("codec_counters") or {}).items():
-                merged_codec[name] = merged_codec.get(name, 0) + count
-        codec_counters = merged_codec or None
+        from ..server.fleet import merge_statusz_block
+
+        codec_counters = merge_statusz_block(
+            final_scrapes.values(), "codec_counters") or None
     # exactly-once ingestion tallies are stamped server-side: in-process
     # runs read the live counters, fleet runs merge the workers' /statusz
     # participation blocks (the counters live in THEIR processes)
     if fleet is not None:
-        participation_counters: dict = {}
-        for doc in final_scrapes.values():
-            for name, count in (doc.get("participation") or {}).items():
-                participation_counters[name] = (
-                    participation_counters.get(name, 0) + count)
+        participation_counters = merge_statusz_block(
+            final_scrapes.values(), "participation")
     else:
         participation_counters = metrics.counter_report(
             "server.participation.") or {}

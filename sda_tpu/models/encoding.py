@@ -137,6 +137,16 @@ class FixedPointCodec:
         centered, matching RecipientOutput.positive()'s canonical band
         (receive.rs:14-21) shifted to (-m/2, m/2].
         """
+        if summands < 1:
+            # a zero/negative summand count is always a caller bug (an
+            # empty frozen set, a None participation count propagated
+            # into the mean): fail typed here rather than as a
+            # ZeroDivisionError inside decode_mean or a silently wrong
+            # "sum of zero things"
+            raise ValueError(
+                f"decode needs at least one summand, got {summands} "
+                "(empty frozen set? use the revealed participation count)"
+            )
         if summands > self.max_summands:
             raise ValueError(
                 f"{summands} summands exceeds configured capacity "

@@ -104,11 +104,30 @@ class FederatedSession:
         self.clerks = list(clerks)
         self.participants = list(participants)
 
-    def round(self, deltas: Sequence[np.ndarray]) -> np.ndarray:
+    def round(self, deltas: Sequence[np.ndarray], *,
+              deadline: float = 60.0) -> np.ndarray:
         """One secure round: encode + participate + clerk + reveal.
 
         ``deltas`` is one float vector per participant (client_params -
         global_params, pre-raveled). Returns the exact decoded *mean* delta.
+
+        The encoded int64 residue array is handed to ``participate``
+        as-is — the client normalizes ndarrays without a per-element
+        Python conversion, so a 10^5-dim model costs one vectorized
+        pass, not 10^5 ``int()`` calls (sda_tpu/loadgen/inputbench.py
+        measures the difference).
+
+        The reveal is driven through the lifecycle plane
+        (:meth:`SdaClient.await_result`): a round the supervisor
+        declared terminal raises the typed
+        :class:`~sda_tpu.protocol.RoundFailed` /
+        :class:`~sda_tpu.protocol.RoundExpired` with the server's
+        diagnosis, and a quorum-degraded Shamir round reveals bit-exactly
+        from the survivors — never a hang, never a silent partial-
+        committee sum. The mean divides by the *revealed* participation
+        count (the snapshot's frozen set), so a round whose committee
+        degraded still averages over exactly the participations it
+        actually summed. ``deadline`` bounds the wait client-side.
         """
         if len(deltas) != len(self.participants):
             raise ValueError("one delta per participant required")
@@ -121,17 +140,24 @@ class FederatedSession:
             delta = np.asarray(delta, dtype=np.float64)
             if delta.shape != (dim,):
                 raise ValueError(f"delta shape {delta.shape} != ({dim},)")
-            encoded = self.codec.encode(delta)
-            participant.participate([int(v) for v in encoded], aggregation.id)
+            participant.participate(self.codec.encode(delta), aggregation.id)
 
         self.recipient.end_aggregation(aggregation.id)
         self.recipient.run_chores(-1)
         for clerk in self.clerks:
             clerk.run_chores(-1)
 
-        output = self.recipient.reveal_aggregation(aggregation.id)
+        output = self.recipient.await_result(
+            aggregation.id, deadline=deadline, poll_interval=0.05)
         values = output.positive().values
-        return self.codec.decode_mean(values, len(self.participants))
+        # None = pre-lifecycle server: fall back to the nominal count. A
+        # REVEALED 0 is a real (degenerate) answer — let decode_mean's
+        # typed empty-summand guard surface it rather than silently
+        # averaging an empty sum over the full population.
+        summands = (output.participations
+                    if output.participations is not None
+                    else len(self.participants))
+        return self.codec.decode_mean(values, summands)
 
 
 def pod_fedavg_round(pod, codec: FixedPointCodec, global_vec: np.ndarray,

@@ -45,7 +45,10 @@ __all__ = ["check", "load_records", "main", "repo_root"]
 #: advisory-only: it varies with cache state by design, as is
 #: scaling_efficiency: the fleet drill's speedup-over-ideal ratio
 #: (docs/scaling.md) is bounded by the host's core count, which varies
-#: across CI machines.
+#: across CI machines. The headline ``value`` defaults to higher-is-
+#: better (throughput), but a record may carry its own ``"direction":
+#: "lower"`` tag — e.g. the FL suite's rounds-to-target-accuracy record
+#: (docs/federated.md), where MORE rounds is the regression.
 METRICS = (
     ("value", "higher", True),
     ("round_seconds_marginal", "lower", True),
@@ -185,6 +188,12 @@ def check(entries: List[dict], window: int = DEFAULT_WINDOW,
     chain_rel = max([chain_rel_uncertainty(e["record"])
                      for e in trailing + [newest]] or [0.0])
     for key, direction, gates in METRICS:
+        if key == "value":
+            # record-carried direction: comparability already pins the
+            # metric string, so every record in the window shares the tag
+            tagged = newest["record"].get("direction")
+            if tagged in ("higher", "lower"):
+                direction = tagged
         new_val = newest["record"].get(key)
         hist = [e["record"][key] for e in trailing
                 if isinstance(e["record"].get(key), (int, float))]

@@ -53,6 +53,18 @@ DRAIN_PREFIX = "sdad drained "
 _LOG_LINES = 200
 
 
+def merge_statusz_block(docs, block: str) -> Dict[str, int]:
+    """Sum one counter block (``"participation"``, ``"codec_counters"``,
+    ...) across worker ``/statusz`` documents. Counters are per-process,
+    so the fleet-wide tally is the sum of the workers' — the shared merge
+    under every drill's exactly-once and codec verdicts."""
+    merged: Dict[str, int] = {}
+    for doc in docs:
+        for name, count in ((doc or {}).get(block) or {}).items():
+            merged[name] = merged.get(name, 0) + count
+    return merged
+
+
 @dataclass
 class FleetWorker:
     """One spawned ``sdad`` process and what the launcher learned about it."""
@@ -250,6 +262,24 @@ class Fleet:
         computes the same mapping from the same node list, so routing
         needs no coordination service (routing.py)."""
         return HashRing(self.node_ids, replicas=self.replicas)
+
+    def scrape_statusz(self, timeout_s: float = 10.0) -> Dict[str, dict]:
+        """Best-effort ``/statusz`` scrape of every addressable worker —
+        ``{node_id: doc}``, unreachable workers silently omitted. Worker
+        counters (exactly-once ingestion tallies, codec traffic, armed
+        failpoints) live in THEIR processes and die on drain, so drills
+        must scrape before ``stop()``; this is the one implementation the
+        load/soak/FL drills share."""
+        import requests
+
+        docs: Dict[str, dict] = {}
+        for node, address in self.addresses.items():
+            try:
+                docs[node] = requests.get(address + "/statusz",
+                                          timeout=timeout_s).json()
+            except Exception:
+                continue
+        return docs
 
     def to_obj(self) -> dict:
         return {"workers": [w.to_obj() for w in self.workers]}

@@ -322,6 +322,14 @@ class RoundScheduler:
                             "epoch": epoch})
         return actions
 
+    def close_epoch(self, spec: ScheduleSpec, epoch: int) -> List[dict]:
+        """Close epoch *e* WITHOUT minting a successor — the drill/
+        shutdown spelling (a finite workload's last round must freeze and
+        clerk without leaving a dangling empty epoch behind; the FL
+        scenario driver uses this for its final round). Idempotent and
+        contended-safe exactly like the tick-driven close."""
+        return self._ensure_closed(spec, epoch)
+
     def _ensure_closed(self, spec: ScheduleSpec, epoch: int) -> List[dict]:
         """Idempotently close epoch *e*'s collection: run the snapshot
         pipeline under the epoch's deterministic snapshot id. Replays and

@@ -516,17 +516,10 @@ def run_soak(profile: SoakProfile) -> dict:
             # exactly-once tallies are stamped server-side, i.e. in the
             # worker processes: scrape each /statusz BEFORE the drain
             # (the counters die with the workers)
-            import requests as _requests
+            from ..server.fleet import merge_statusz_block
 
-            for address in fleet.addresses.values():
-                try:
-                    doc = _requests.get(address + "/statusz",
-                                        timeout=10.0).json()
-                except Exception:
-                    continue
-                for name, count in (doc.get("participation") or {}).items():
-                    participation_counters[name] = (
-                        participation_counters.get(name, 0) + count)
+            participation_counters = merge_statusz_block(
+                fleet.scrape_statusz().values(), "participation")
             drain_summaries = fleet.stop()
         if http_server is not None:
             http_server.shutdown()

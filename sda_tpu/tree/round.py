@@ -97,6 +97,8 @@ def run_tree_round(
     sweep_interval_s: float = 0.2,
     lease_seconds: float = 0.75,
     service=None,
+    reset_obs: bool = True,
+    return_output: bool = False,
 ) -> TreeRoundReport:
     """Drive one full tree round; returns the report dict.
 
@@ -111,6 +113,14 @@ def run_tree_round(
     terminal ``failed`` and the ROOT round fails with a reason naming
     the leaf. ``service`` injects an existing in-process service (tests);
     otherwise one is built from ``store``/``http``.
+
+    ``reset_obs=False`` keeps the caller's span/metrics/failpoint state
+    (an embedding workload — the FL scenario runs one tree round per
+    FedAvg round under its own trace — must not have its telemetry wiped
+    per call). ``return_output=True`` attaches the revealed root vector
+    as ``report["output_values"]`` (an int64 ndarray — NOT JSON-able, so
+    it is opt-in; the JSON-bound ``sda-sim --tree`` profile leaves it
+    off).
     """
     from ..client import SdaClient, relay as relay_mod
     from ..crypto import MemoryKeystore, sodium
@@ -125,8 +135,9 @@ def run_tree_round(
     scheme = _make_schemes(sharing, modulus, share_count)
     masking_scheme = _make_masking(masking, modulus, dim)
 
-    obs.reset_all()
-    chaos.reset()
+    if reset_obs:
+        obs.reset_all()
+        chaos.reset()
     own_service = service is None
     http_server = None
     if own_service:
@@ -423,6 +434,8 @@ def run_tree_round(
                 revealed = output.positive().values
                 report["exact"] = bool((revealed == expected).all())
                 report["relays"] = int(output.participations or 0)
+                if return_output:
+                    report["output_values"] = revealed
                 if dim <= 16:
                     report["output"] = [int(v) for v in revealed]
             else:

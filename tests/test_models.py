@@ -65,6 +65,56 @@ def test_codec_device_matches_host():
     np.testing.assert_array_equal(host, dev)
 
 
+@pytest.mark.parametrize("modulus,fractional_bits,max_summands,clip", [
+    (M31, 12, 4, 4.0),            # wide modulus, generous headroom
+    (M31, 16, 100, 1.0),          # fine grid, many summands
+    ((1 << 20), 8, 3, None),      # small power-of-two modulus, derived clip
+    ((1 << 24) - 3, 4, 50, None),  # coarse grid at the capacity-derived cap
+])
+def test_codec_host_device_bit_exact_property_matrix(
+        modulus, fractional_bits, max_summands, clip):
+    """The host/device codec claim, at the edges: ``encode`` ==
+    ``encode_device`` element-wise over clip boundaries, negative halves,
+    the q_max boundary, half-to-even rounding ties, and a random cloud —
+    the exactness argument of docs/models.md leans on this equality."""
+    codec = FixedPointCodec(modulus, fractional_bits=fractional_bits,
+                            max_summands=max_summands, clip=clip)
+    step = 1.0 / codec.scale
+    eps = step / 8.0
+    ties = (np.arange(-9, 9, dtype=np.float64) + 0.5) * step  # .5 grid ties
+    probes = np.concatenate([
+        np.array([0.0, -0.0, codec.clip, -codec.clip,          # clip edges
+                  codec.clip - eps, -codec.clip + eps,
+                  codec.clip + 1.0, -codec.clip - 1.0,         # beyond clip
+                  codec.clip * 3, -codec.clip * 3]),
+        ties, -ties[::-1],                                     # half-to-even
+        np.array([step, -step, step / 2, -step / 2,            # neg halves
+                  1.5 * step, -1.5 * step, 2.5 * step, -2.5 * step]),
+        np.random.default_rng(17).normal(0, codec.clip, size=64),
+    ])
+    host = codec.encode(probes)
+    dev = np.asarray(codec.encode_device(probes), dtype=np.int64)
+    np.testing.assert_array_equal(host, dev)
+    # both paths clamp the quantized value to the q_max boundary exactly
+    q_max = int(round(codec.clip * codec.scale))
+    centered = host - np.where(host > modulus // 2, modulus, 0)
+    assert centered.max() == q_max and centered.min() == -q_max
+    # and the ties actually rounded half to EVEN on both paths
+    tie_q = codec.quantize(ties)
+    assert (tie_q % 2 == 0).all(), tie_q
+
+
+def test_codec_decode_rejects_empty_summand_set():
+    """decode_sum/decode_mean with summands < 1 is always a caller bug
+    (empty frozen set): typed error, not ZeroDivisionError or a silent
+    'sum of nothing'."""
+    codec = FixedPointCodec(M31, fractional_bits=8, max_summands=4)
+    with pytest.raises(ValueError, match="at least one summand"):
+        codec.decode_mean(np.zeros(4, np.int64), 0)
+    with pytest.raises(ValueError, match="at least one summand"):
+        codec.decode_sum(np.zeros(4, np.int64), -2)
+
+
 def test_modulus_mismatch_is_rejected():
     """A codec/aggregation modulus mismatch must fail loudly, not decode
     garbage (both FedAvg surfaces validate it)."""
@@ -269,6 +319,110 @@ def test_federated_session_packed_shamir_semantics():
         [c for c in clerks if c is not clerks[5]], participants)
     mean2 = session_drop.round(list(-deltas))
     np.testing.assert_array_equal(mean2, -expected)
+
+
+def test_federated_session_surfaces_typed_round_verdict():
+    """A round that cannot complete (additive sharing, one clerk never
+    clerks) must surface a typed lifecycle verdict from ``await_result``
+    within the deadline — not hang, not silently decode a partial
+    committee sum, not a bare NotFound."""
+    from sda_tpu.crypto import sodium
+
+    if not sodium.available():
+        pytest.skip("libsodium not present")
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        NoMasking,
+        RoundFailed,
+        SodiumEncryption,
+    )
+    from sda_tpu.server import new_memory_server
+
+    dim, n_part = 8, 2
+    service = new_memory_server()
+    recipient = _new_client(service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+    clerks = [_new_client(service) for _ in range(3)]
+    for c in clerks:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    participants = [_new_client(service) for _ in range(n_part)]
+    for p in participants:
+        p.upload_agent()
+    template = Aggregation(
+        id=AggregationId.random(), title="fedavg-dead", vector_dimension=dim,
+        modulus=M31, recipient=recipient.agent.id, recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        # a 4-of-4 committee over exactly 4 key-holders (recipient + 3
+        # clerks): election MUST include clerk 2, whose chores the
+        # session below never runs — deterministic regardless of the
+        # uuid-sorted suggestion order (the recipient's own chores ARE
+        # run by FederatedSession.round)
+        committee_sharing_scheme=AdditiveSharing(share_count=4, modulus=M31),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    codec = FixedPointCodec(M31, fractional_bits=8, max_summands=n_part,
+                            clip=1.0)
+    # clerk 2 never runs chores: additive n-of-n can never reconstruct
+    session = FederatedSession(template, codec, recipient, clerks[:2],
+                               participants)
+    deltas = np.random.default_rng(1).normal(0, 0.5, size=(n_part, dim))
+    with pytest.raises(RoundFailed):  # RoundExpired subclasses RoundFailed
+        session.round(list(deltas), deadline=1.0)
+
+
+def test_participation_input_ndarray_fast_path():
+    """The encoded int64 ndarray goes through ``participate`` without a
+    per-element Python conversion; raw float arrays are rejected (a
+    silent float->int64 truncation would bypass the codec contract)."""
+    from sda_tpu.crypto import sodium
+
+    if not sodium.available():
+        pytest.skip("libsodium not present")
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        NoMasking,
+        SodiumEncryption,
+    )
+    from sda_tpu.server import new_memory_server
+
+    service = new_memory_server()
+    recipient = _new_client(service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+    clerks = [_new_client(service) for _ in range(3)]
+    for c in clerks:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    participant = _new_client(service)
+    participant.upload_agent()
+    aggregation = Aggregation(
+        id=AggregationId.random(), title="nd", vector_dimension=16,
+        modulus=M31, recipient=recipient.agent.id, recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=M31),
+        recipient_encryption_scheme=SodiumEncryption(),
+        committee_encryption_scheme=SodiumEncryption(),
+    )
+    recipient.upload_aggregation(aggregation)
+    recipient.begin_aggregation(aggregation.id)
+    codec = FixedPointCodec(M31, fractional_bits=8, max_summands=2, clip=1.0)
+    encoded = codec.encode(np.random.default_rng(2).normal(0, 0.4, size=16))
+    assert encoded.dtype == np.int64
+    participant.participate(encoded, aggregation.id)  # ndarray, no list()
+    status = service.get_aggregation_status(recipient.agent, aggregation.id)
+    assert status.number_of_participations == 1
+    with pytest.raises(ValueError, match="FixedPointCodec"):
+        participant.new_participation(
+            np.zeros(16, dtype=np.float64), aggregation.id)
 
 
 # ---------------------------------------------------------------------------

@@ -135,6 +135,28 @@ def test_codec_tagged_records_gate_among_themselves(tmp_path):
     assert "value" in result["regressions"]
 
 
+def test_record_carried_direction_lower(tmp_path):
+    # the FL suite's rounds-to-target record tags itself direction=lower:
+    # MORE rounds is the regression, fewer is an improvement — the gate
+    # must honor the tag instead of the default higher-is-better
+    def record(n, value):
+        path = tmp_path / f"FL_r{n:02d}.json"
+        path.write_text(json.dumps({
+            "metric": "rounds to target accuracy 0.8 (secure FedAvg)",
+            "value": value, "direction": "lower", "unit": "rounds",
+            "platform": "cpu", "seed": 1,
+        }))
+        return str(path)
+
+    history = [record(n, v) for n, v in enumerate([3, 3, 4])]
+    worse = regress.check(regress.load_records(history + [record(9, 8)]))
+    assert worse["checked"]
+    assert "value" in worse["regressions"]
+    better = regress.check(regress.load_records(history + [record(9, 2)]))
+    assert better["checked"]
+    assert better["regressions"] == []
+
+
 def test_json_output_mode(capsys):
     assert regress.main(_history() + ["--json"]) == 0
     out = capsys.readouterr().out.strip().splitlines()[-1]
