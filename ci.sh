@@ -150,6 +150,88 @@ PY
 python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$CHURN_RECORD"
 rm -f "$CHURN_RECORD"
 
+echo "== async-plane A/B (same fixed-seed chaos+churn drill, threaded vs asyncio event-loop plane: bit-exact, identical exactly-once counters)"
+AB_THREADED=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --chaos --churn 0.35 \
+  --chaos-store sqlite --chaos-seed 20260803 --chaos-rate 0.05)
+AB_ASYNC=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --chaos --churn 0.35 \
+  --chaos-store sqlite --chaos-seed 20260803 --chaos-rate 0.05 --async-http)
+AB_THREADED="$AB_THREADED" AB_ASYNC="$AB_ASYNC" python - <<'PY'
+import json, os
+threaded = json.loads(os.environ["AB_THREADED"].strip().splitlines()[-1])
+asyncp = json.loads(os.environ["AB_ASYNC"].strip().splitlines()[-1])
+assert threaded["http_plane"] == "threaded" and asyncp["http_plane"] == "async"
+# the plane must be invisible to the protocol: same fixed seed -> same
+# bit-exact reveal, same churn resolution, same exactly-once verdicts
+for key in ("exact", "ready", "participants_churned", "participants_resumed",
+            "participations_replayed", "equivocations_detected",
+            "equivocations_undetected", "double_counted",
+            "admitted_participations"):
+    assert threaded[key] == asyncp[key], (key, threaded[key], asyncp[key])
+assert threaded["exact"] is True, threaded
+part = lambda rep: {k: v for k, v in rep["counters"].items()
+                    if k.startswith("server.participation.")}
+assert part(threaded) == part(asyncp), (part(threaded), part(asyncp))
+print(f"async-plane A/B OK: exact on both planes, participation counters "
+      f"{part(asyncp)} identical, "
+      f"{asyncp['participants_resumed']} resumed on each")
+PY
+
+echo "== job-pickup bench (fixed seed: long-poll vs 0.5s polling clerks on the async plane; >=10x lower p99 gated)"
+PICKUP_RECORD=$(mktemp /tmp/sda-pickup-XXXX.json)
+PICKUP=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --pickup \
+  --pickup-snapshots 6 --pickup-interval 0.5 --pickup-wait 10 \
+  --pickup-seed 20260803)
+PICKUP="$PICKUP" PICKUP_RECORD="$PICKUP_RECORD" python - <<'PY'
+import json, os
+record = json.loads(os.environ["PICKUP"].strip().splitlines()[-1])
+# both modes closed their rounds bit-exactly; the long-poll win is the
+# acceptance bar: enqueue->lease p99 at least 10x below the polling
+# baseline on the same fixed-seed round
+assert record["exact"] is True, record
+assert record["samples"] >= 40, record
+assert record["value"] is not None and record["value"] > 0, record
+assert record["speedup_p99"] and record["speedup_p99"] >= 10.0, record
+with open(os.environ["PICKUP_RECORD"], "w") as f:
+    json.dump(record, f)
+print(f"pickup bench OK: long-poll p99 {record['value']}ms vs polling "
+      f"{record['polling']['p99_ms']}ms ({record['speedup_p99']}x, "
+      f"{record['samples']} samples)")
+PY
+# the pickup record (direction=lower) must parse and gate advisory
+python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$PICKUP_RECORD"
+rm -f "$PICKUP_RECORD"
+
+echo "== connection storm (10k held connections on one async-plane sdad worker: zero 5xx, bounded RSS, clean drain)"
+STORM_RECORD=$(mktemp /tmp/sda-storm-XXXX.json)
+STORM=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --connstorm 10000 \
+  --connstorm-waves 2 --connstorm-rss-limit 1024)
+STORM="$STORM" STORM_RECORD="$STORM_RECORD" python - <<'PY'
+import json, os
+record = json.loads(os.environ["STORM"].strip().splitlines()[-1])
+# the async-plane capacity verdict: every connection opened and served
+# on every wave (10k unless the host fd limit clamps — then the record
+# says so), zero 5xx from exhaustion (shedding would be 429/503), RSS
+# bounded, and the SIGTERM drain still clean with every socket open
+assert record["ok"] is True, record
+assert record["errors_5xx"] == 0, record["statuses"]
+assert record["transport_failures"] == 0, record
+assert record["connect_failures"] == 0, record
+assert record["leaked"] == 0, record["drain"]
+if not record["clamped_by_fd_limit"]:
+    assert record["value"] == 10000, record
+assert record["rss_bounded"] is True, record
+with open(os.environ["STORM_RECORD"], "w") as f:
+    json.dump(record, f)
+print(f"connstorm OK: {record['value']} connections held "
+      f"({record['per_connection_kb']} KiB/conn growth, RSS "
+      f"{record['rss_mb']}MiB <= {record['rss_limit_mb']}MiB), "
+      f"{sum(w['requests'] for w in record['waves'])} pings, "
+      f"drain leaked={record['leaked']}")
+PY
+# the connection-capacity record must parse and gate advisory
+python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$STORM_RECORD"
+rm -f "$STORM_RECORD"
+
 echo "== tree drill (fixed seed: 2-level tree over sqlite+HTTP, ~10% leaf dropout, bit-exact vs flat reference; simulated 1e5-participant record)"
 TREE=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --tree --participants 24 --dim 4 \
   --tree-group-size 6 --tree-seed 20260803 --tree-dropout 0.1 --tree-sim 100000)

@@ -65,6 +65,7 @@ def run_chaos_drill(
     sweep_interval_s: float = 0.2,
     brownout_s: float = 0.0,
     churn_rate: float = 0.0,
+    async_http: bool = False,
 ) -> dict:
     """Run one full aggregation round over HTTP under injected faults.
 
@@ -110,6 +111,12 @@ def run_chaos_drill(
     ``extra_spec`` is one spec string or a list of them (the repeatable
     ``--chaos-spec`` flag), merged with conflict rejection.
 
+    ``async_http`` serves the drill on the asyncio event-loop plane
+    (``http/aserver.py``) instead of thread-per-connection — the SAME
+    fixed seed must produce a bit-exact reveal and identical
+    ``server.participation.*`` counters on both planes (the ci.sh A/B
+    step pins it; docs/scaling.md).
+
     Returns the report dict (``exact``, ``injected_ratio``, the round's
     lifecycle history, counters...). Requires libsodium (real sealed-box
     crypto, as in production rounds).
@@ -119,7 +126,7 @@ def run_chaos_drill(
     from ..client import SdaClient
     from ..client.journal import ParticipationJournal
     from ..crypto import MemoryKeystore, sodium
-    from ..http import SdaHttpClient, SdaHttpServer
+    from ..http import SdaHttpClient, server_class
     from ..protocol import (
         AdditiveSharing,
         Aggregation,
@@ -192,7 +199,7 @@ def run_chaos_drill(
     # point is surviving process death — rejoined clients read it cold
     journal_dir = tempfile.TemporaryDirectory(prefix="sda-churn-journal-")
 
-    http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
+    http_server = server_class(async_http)(service_impl, bind="127.0.0.1:0")
     http_server.start_background()
     try:
         # ONE round span ties every role together: participant uploads,
@@ -480,8 +487,12 @@ def run_chaos_drill(
     round_history = (final_round.history
                      if dead_clerks and final_round is not None else None)
     breaker_report = breaker.report() if breaker is not None else None
+    pickup_summary = metrics.histogram_report("server.job.pickup").get(
+        "server.job.pickup")
     report = {
-        "mode": f"chaos drill over HTTP ({store} store)",
+        "mode": (f"chaos drill over HTTP ({store} store, "
+                 f"{'async' if async_http else 'threaded'} plane)"),
+        "http_plane": "async" if async_http else "threaded",
         "participants": participants,
         "dim": dim,
         "clerks": scheme.share_count,
@@ -545,6 +556,14 @@ def run_chaos_drill(
         # per-route server latency under fire: the tail the retry budget
         # has to ride out (loadgen measures the same table under load)
         "latency_ms": _latency_report_ms(),
+        # enqueue->lease latency (server.job.pickup): the long-poll
+        # plane's headline metric, surfaced here so the chaos drill's
+        # fixed-seed A/B carries it too (docs/load.md)
+        "job_pickup_ms": ({
+            "count": int(pickup_summary["count"]),
+            "p50_ms": round(pickup_summary["p50"] * 1e3, 3),
+            "p99_ms": round(pickup_summary["p99"] * 1e3, 3),
+        } if pickup_summary else None),
         "trace": timelines[0] if timelines else None,
     }
     return report

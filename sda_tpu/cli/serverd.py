@@ -20,6 +20,15 @@ def build_parser() -> argparse.ArgumentParser:
     backend.add_argument("--mongo", metavar="URI", help="MongoDB URI (needs pymongo)")
     parser.add_argument("--mongo-dbname", default="sda")
     backend.add_argument("--memory", action="store_true", help="in-memory store")
+    parser.add_argument("--async", dest="async_http", action="store_true",
+                        help="serve on the asyncio event-loop HTTP plane "
+                             "(SdaAsyncHttpServer) instead of the "
+                             "thread-per-connection plane: idle keep-alive "
+                             "connections and parked long-polls "
+                             "(GET /v1/clerking-jobs?wait=S) hold no "
+                             "threads, so one worker sustains 10k+ open "
+                             "connections; wire behavior is identical "
+                             "(docs/scaling.md)")
     parser.add_argument("--premix-paillier", action="store_true",
                         help="homomorphically combine clerk columns at "
                              "snapshot time for PackedPaillier aggregations")
@@ -198,7 +207,7 @@ def main(argv=None) -> int:
     from ..utils import configure_logging
 
     configure_logging(args.verbose)
-    from ..http import SdaHttpServer
+    from ..http import server_class
     from ..server import (
         new_jsonfs_server,
         new_memory_server,
@@ -290,7 +299,7 @@ def main(argv=None) -> int:
         chaos.set_identity(args.node_id)
         chaos.configure_from_specs(args.chaos_spec, seed=args.chaos_seed)
 
-    server = SdaHttpServer(
+    server = server_class(args.async_http)(
         service, bind=args.bind,
         max_inflight=args.max_inflight,
         rate_limit=args.rate_limit,

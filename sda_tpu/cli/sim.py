@@ -301,6 +301,51 @@ def build_parser() -> argparse.ArgumentParser:
                         help="packed-Shamir sharing prime size (--fl)")
     parser.add_argument("--fl-seed", type=int, default=0,
                         help="data/shard/churn/DP seed (--fl)")
+    parser.add_argument("--async-http", action="store_true",
+                        help="serve the drill profiles (--chaos, --load, "
+                             "--fl) on the asyncio event-loop HTTP "
+                             "plane instead of thread-per-connection — "
+                             "fixed-seed drills must stay bit-exact "
+                             "across planes (docs/scaling.md); --pickup "
+                             "and --connstorm bench the async plane "
+                             "directly (--connstorm-threaded compares)")
+    parser.add_argument("--pickup", action="store_true",
+                        help="job-pickup A/B bench: the SAME fixed-seed "
+                             "multi-snapshot round driven by polling "
+                             "clerks and then long-poll clerks "
+                             "(GET /v1/clerking-jobs?wait=S); prints the "
+                             "BENCH record whose headline is the "
+                             "long-poll enqueue->lease p99 (direction: "
+                             "lower) with the polling baseline and "
+                             "speedup alongside (docs/load.md)")
+    parser.add_argument("--pickup-snapshots", type=int, default=6,
+                        help="snapshots per mode — samples = snapshots x "
+                             "committee size (--pickup)")
+    parser.add_argument("--pickup-interval", type=float, default=0.5,
+                        help="polling baseline's sleep between empty "
+                             "polls, seconds (--pickup)")
+    parser.add_argument("--pickup-wait", type=float, default=10.0,
+                        help="long-poll park budget per request, seconds "
+                             "(--pickup)")
+    parser.add_argument("--pickup-seed", type=int, default=0,
+                        help="input/stagger seed (--pickup)")
+    parser.add_argument("--connstorm", type=int, metavar="N", default=0,
+                        help="connection-storm drill: hold N concurrent "
+                             "open connections against ONE sdad worker "
+                             "subprocess (async plane unless "
+                             "--connstorm-threaded), ping in waves, "
+                             "assert zero 5xx + bounded RSS + clean "
+                             "SIGTERM drain; prints the BENCH record "
+                             "(docs/scaling.md)")
+    parser.add_argument("--connstorm-waves", type=int, default=2,
+                        help="request waves over the held connections "
+                             "(--connstorm)")
+    parser.add_argument("--connstorm-rss-limit", type=float, default=1024.0,
+                        help="worker RSS ceiling in MiB with every "
+                             "connection open (--connstorm)")
+    parser.add_argument("--connstorm-threaded", action="store_true",
+                        help="storm the thread-per-connection plane "
+                             "instead (comparison runs) (--connstorm)")
     parser.add_argument("--chaos", action="store_true",
                         help="robustness profile: run a full federated "
                              "round over real HTTP with deterministic "
@@ -520,6 +565,7 @@ def _run_load(args) -> int:
                 chaos_rate=chaos_rate,
                 churn=args.load_churn,
                 codec=args.load_codec,
+                async_http=args.async_http,
             ),
             nodes=args.load_fleet,
             baseline_nodes=args.load_fleet_baseline,
@@ -547,6 +593,7 @@ def _run_load(args) -> int:
             chaos_rate=chaos_rate,
             churn=args.load_churn,
             codec=args.load_codec,
+            async_http=args.async_http,
         ))
     _export_trace(args, report)
     print(json.dumps(report))
@@ -734,6 +781,7 @@ def _run_fl(args) -> int:
             store=store,
             store_path=None if store == "memory" else f"{tmp}/store",
             http=args.fl_http,
+            async_http=args.async_http,
             fleet=args.fl_fleet,
             chaos_rate=args.fl_chaos_rate,
             tree_group_size=args.fl_tree_group,
@@ -762,6 +810,50 @@ def _run_fl(args) -> int:
     if args.fl_fleet:
         ok = ok and report["fleet"]["leaked"] == 0
     return 0 if ok else 1
+
+
+def _run_pickup(args) -> int:
+    """--pickup: the job-pickup A/B bench (sda_tpu/loadgen/pickup.py) —
+    the SAME fixed-seed multi-snapshot round with polling clerks, then
+    long-poll clerks, reported as one BENCH-style JSON line whose
+    headline is the long-poll enqueue->lease p99 (direction: lower)."""
+    from ..crypto import sodium
+    from ..loadgen import PickupProfile, run_pickup_bench
+
+    if not sodium.available():
+        print("error: --pickup needs libsodium (real-crypto round)",
+              file=sys.stderr)
+        return 1
+    record = run_pickup_bench(PickupProfile(
+        snapshots=args.pickup_snapshots,
+        poll_interval=args.pickup_interval,
+        wait_s=args.pickup_wait,
+        seed=args.pickup_seed,
+        # both modes serve from the async plane so the A/B isolates the
+        # delivery mechanism (polling vs long-poll), not the transport
+        async_http=True,
+    ))
+    _export_trace(args, record)
+    print(json.dumps(record))
+    ok = (record["exact"] and record["value"] is not None
+          and (record["speedup_p99"] or 0) >= 1.0)
+    return 0 if ok else 1
+
+
+def _run_connstorm(args) -> int:
+    """--connstorm N: hold N open connections against one sdad worker
+    subprocess, ping in waves, check RSS and the SIGTERM drain
+    (sda_tpu/loadgen/connstorm.py); one BENCH-style JSON line."""
+    from ..loadgen import ConnstormProfile, run_connstorm
+
+    record = run_connstorm(ConnstormProfile(
+        connections=args.connstorm,
+        waves=args.connstorm_waves,
+        rss_limit_mb=args.connstorm_rss_limit,
+        async_http=not args.connstorm_threaded,
+    ))
+    print(json.dumps(record))
+    return 0 if record["ok"] else 1
 
 
 def _run_chaos(args) -> int:
@@ -797,6 +889,7 @@ def _run_chaos(args) -> int:
             sharing=args.chaos_sharing,
             brownout_s=args.brownout,
             churn_rate=args.churn,
+            async_http=args.async_http,
         )
     _export_trace(args, report)
     print(json.dumps(report))
@@ -848,6 +941,10 @@ def main(argv=None) -> int:
 
     if args.load:
         return _run_load(args)
+    if args.pickup:
+        return _run_pickup(args)
+    if args.connstorm:
+        return _run_connstorm(args)
     if args.fl:
         return _run_fl(args)
     if args.soak:

@@ -470,6 +470,14 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
         job = self.service.get_clerking_job(self.agent, self.agent.id)
         if job is None:
             return False
+        return self._clerk_job(job)
+
+    def _clerk_job(self, job: ClerkingJob) -> bool:
+        """Process one pulled job and upload its result (the shared tail
+        of :meth:`clerk_once` and :meth:`run_clerk`); False when the
+        abandon failpoint ate the job."""
+        from .. import chaos
+
         # parent the processing to the trace that ENQUEUED the job (the
         # round's snapshot), recorded server-side at enqueue time and
         # propagated here via the X-Trace-Context poll header or the
@@ -502,6 +510,117 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
             if not self.clerk_once():
                 break
             iterations += 1
+
+    def clerk_poll(self, wait_s: float = 0.0) -> Optional[ClerkingJob]:
+        """One job poll, long-poll flavored when the service supports it:
+        ``await_clerking_job`` (the HTTP proxy's
+        ``GET /v1/clerking-jobs?wait=S``, or the in-process seam's
+        wakeup-parked wait) blocks up to ``wait_s`` for work; a seam
+        without the method (old peers, third-party services) answers
+        immediately and :meth:`run_clerk` supplies the sleep."""
+        waiter = getattr(self.service, "await_clerking_job", None)
+        if wait_s > 0 and waiter is not None:
+            return waiter(self.agent, self.agent.id, wait_s)
+        return self.service.get_clerking_job(self.agent, self.agent.id)
+
+    def run_clerk(
+        self,
+        *,
+        wait_s: float = 30.0,
+        poll_interval: float = 0.5,
+        max_jobs: Optional[int] = None,
+        deadline: Optional[float] = None,
+        stop=None,
+        idle_exit: bool = False,
+    ) -> int:
+        """The long-running clerk loop (``SdaClerk.run_clerk``): pull and
+        process jobs forever, discovering work by LONG-POLL instead of a
+        sleep loop — job-pickup latency collapses from the polling
+        interval to the server's wakeup hop (docs/http.md).
+
+        Against a long-poll-capable service each empty iteration is one
+        parked request of up to ``wait_s``; against an old peer the loop
+        degrades to immediate polls spaced ``poll_interval`` apart
+        (jittered per agent — no fleet-wide stampede). Transient server
+        trouble (a draining worker's 503, a browning-out store) is
+        absorbed: the loop backs off honoring the ``Retry-After`` hint
+        when the error carries one and keeps going.
+
+        Exits when ``max_jobs`` are processed, the ``deadline`` (seconds)
+        passes, ``stop`` (an ``Event``-like with ``is_set``) fires, the
+        permanent-death failpoint kills this clerk, or — with
+        ``idle_exit`` — the first empty poll after at least one processed
+        job. Returns how many jobs were processed."""
+        import random as _random
+
+        from .. import chaos
+
+        give_up = (None if deadline is None
+                   else time.monotonic() + float(deadline))
+        jitter_rng = _random.Random(f"{self.agent.id}:clerk")
+        processed = 0
+        while True:
+            if stop is not None and stop.is_set():
+                return processed
+            if max_jobs is not None and processed >= max_jobs:
+                return processed
+            if give_up is not None and time.monotonic() >= give_up:
+                return processed
+            if self._dead or chaos.evaluate(
+                    "clerk.dies", kinds=("kill",)) is not None:
+                self._dead = True
+                return processed
+            budget = (wait_s if give_up is None
+                      else min(wait_s, max(0.0, give_up - time.monotonic())))
+            retry_after = None
+            errored = False
+            poll_t0 = time.monotonic()
+            try:
+                job = self.clerk_poll(wait_s=budget)
+            except (ServerError, OSError) as e:
+                # a drain 503 or brownout past the transport's retry
+                # budget: the fleet is recovering, not gone — back off on
+                # the server's schedule and re-poll. OSError covers the
+                # transport's raw connection/timeout errors once ITS
+                # retry budget exhausts (requests exceptions are
+                # IOErrors): a restarting worker must not kill the clerk
+                # daemon permanently
+                metrics.count("clerk.poll.transient")
+                errored = True
+                retry_after = getattr(e, "retry_after", None)
+                job = None
+            if job is not None:
+                if self._clerk_job(job):
+                    processed += 1
+                continue
+            # idle_exit fires on an EMPTY poll only — a failed poll says
+            # nothing about the queue, so it backs off and retries
+            if not errored and idle_exit and processed:
+                return processed
+            # a long-poll-capable service already slept server-side; an
+            # old peer (detected by the transport's first bare 404)
+            # returns immediately, so WE must supply the cadence or the
+            # loop busy-spins at the server. The elapsed check catches a
+            # server that CLAIMS long-poll but didn't actually park (its
+            # SDA_LONGPOLL_MAX clamped our wait toward zero): an empty
+            # answer that came back in well under the asked-for budget
+            # earns a client-side sleep, or every clerk hammers the
+            # store in a tight loop
+            supports = getattr(self.service, "longpoll_supported", None)
+            long_polled = (budget > 0 and getattr(
+                self.service, "await_clerking_job", None) is not None
+                and (supports is None or supports())
+                and not errored
+                and (time.monotonic() - poll_t0)
+                >= 0.5 * min(budget, poll_interval))
+            if not long_polled:
+                # old peer (or backoff hint): the classic sleep, jittered
+                base_sleep = retry_after if retry_after else poll_interval
+                sleep = base_sleep * (0.5 + jitter_rng.random())
+                if give_up is not None:
+                    sleep = min(sleep, max(0.0, give_up - time.monotonic()))
+                if sleep > 0:
+                    time.sleep(sleep)
 
     def process_clerking_job(self, job: ClerkingJob) -> ClerkingResult:
         """Decrypt shares -> modular sum -> re-encrypt to recipient
@@ -918,6 +1037,11 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
 #: where only ``participate(..., journal=...)`` / ``resume(journal)``
 #: matter — the durable sporadic-device entry points (docs/client.md).
 SdaParticipant = SdaClient
+
+#: Role alias for the clerking view: a committee-member process that
+#: lives in :meth:`SdaClient.run_clerk` — long-poll job discovery, lease
+#: handback on drain, lifecycle-diagnosed death (docs/http.md).
+SdaClerk = SdaClient
 
 from .journal import ParticipationJournal  # noqa: E402  (re-export)
 from . import relay  # noqa: E402  (the tree-round relay role; docs/scaling.md)

@@ -203,6 +203,7 @@ def await_masked(
     with obs.span("relay.await_masked",
                   attributes={"aggregation": str(aggregation_id)}):
         while True:
+            retry_after = None
             try:
                 round_status = client.service.get_round_status(
                     client.agent, aggregation_id)
@@ -243,10 +244,14 @@ def await_masked(
                         total.state = (round_status.state
                                        if round_status is not None else None)
                         return total
-            except ServerError:
+            except ServerError as e:
                 # transient transport/store trouble past the retry budget:
-                # the leaf round itself may be fine — keep waiting
+                # the leaf round itself may be fine — keep waiting, on
+                # the SERVER's schedule when the 503 carried a
+                # Retry-After hint (breaker-open and draining workers
+                # stamp one), exactly like SdaClient.await_result
                 metrics.count("relay.await.transient")
+                retry_after = getattr(e, "retry_after", None)
             if give_up is not None and time.monotonic() >= give_up:
                 raise RoundExpired(
                     f"relay await_masked deadline exceeded for "
@@ -255,7 +260,10 @@ def await_masked(
                            if round_status is not None else None),
                     reason="relay await_masked deadline exceeded",
                 )
-            sleep = poll_interval * (0.5 + jitter_rng.random())
+            # Retry-After beats the cadence; both get the seeded jitter,
+            # and the sleep never outlives the remaining deadline
+            sleep = (retry_after if retry_after
+                     else poll_interval) * (0.5 + jitter_rng.random())
             if give_up is not None:
                 sleep = min(sleep, max(0.0, give_up - time.monotonic()))
             time.sleep(sleep)

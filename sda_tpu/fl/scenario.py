@@ -79,6 +79,7 @@ class FLProfile:
     store: str = "memory"           # memory | sqlite | jsonfs
     store_path: Optional[str] = None
     http: bool = False              # single real HTTP server
+    async_http: bool = False        # serve HTTP on the asyncio plane
     fleet: int = 0                  # N sdad workers over the shared store
     chaos_rate: float = 0.0         # fraction of HTTP requests to 500
     tree_group_size: int = 0        # >0: aggregate via sda_tpu/tree
@@ -250,6 +251,12 @@ def run_fl(profile: FLProfile) -> dict:
     if profile.chaos_rate and profile.tree_group_size:
         raise ValueError("tree mode does not arm the chaos knob; use "
                          "churn (leaf dropout) or the protocol mode")
+    if profile.async_http and not (profile.http or profile.fleet):
+        # a silently ignored plane flag would mislabel every benchmark
+        # collected with it — refuse instead
+        raise ValueError("async_http selects the HTTP serving plane; add "
+                         "--fl-http or --fl-fleet (in-process mode has "
+                         "no HTTP plane to select)")
     if profile.chaos_rate and not (profile.http or profile.fleet):
         # the chaos knob arms the HTTP dispatch failpoint: without an
         # HTTP layer in the path nothing evaluates it, and a "survived
@@ -320,7 +327,7 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
     from ..client.journal import ParticipationJournal
     from ..crypto import MemoryKeystore
     from ..fields import numtheory
-    from ..http import SdaHttpClient, SdaHttpServer
+    from ..http import SdaHttpClient, server_class
     from ..protocol import (
         Aggregation,
         AggregationId,
@@ -360,6 +367,8 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
                    if profile.store == "sqlite"
                    else ["--jfs", profile.store_path])
         extra = ["--job-lease", str(profile.lease_seconds), "--statusz"]
+        if profile.async_http:
+            extra += ["--async"]
         if profile.chaos_rate > 0.0:
             extra += ["--chaos-spec",
                       f"http.server.request=error,rate={profile.chaos_rate}",
@@ -385,7 +394,8 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
         service_impl.server.clerking_lease_seconds = profile.lease_seconds
         server = service_impl.server
         if profile.http:
-            http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
+            http_server = server_class(profile.async_http)(
+                service_impl, bind="127.0.0.1:0")
             http_server.start_background()
 
     if profile.dead_clerks:
@@ -937,6 +947,10 @@ def _base_report(profile: FLProfile, dim, codec, accuracy_by_round,
         "direction": "lower",
         "unit": "rounds",
         "platform": "cpu",
+        # which serving transport carried the rounds (None: in-process,
+        # no HTTP plane in the path) — benchmark evidence must say
+        "http_plane": (("async" if profile.async_http else "threaded")
+                       if (profile.http or profile.fleet) else None),
         "seed": profile.seed,
         "family": profile.family,
         "dataset": profile.dataset,

@@ -47,6 +47,7 @@ from ..protocol import (
 )
 from ..protocol import bincodec
 from .admission import TENANT_HEADER
+from ..utils.env import env_float as _env_float
 
 TOKEN_ALIAS = "auth-token"
 
@@ -115,17 +116,6 @@ _IDEMPOTENT_POST_ROUTES = tuple(
 )
 
 
-def _env_float(name: str, default: float) -> float:
-    raw = _os.environ.get(name)
-    if raw is None or not raw.strip():
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        log.warning("ignoring unparseable %s=%r", name, raw)
-        return default
-
-
 def _load_or_mint_token(store, agent_id: AgentId) -> str:
     """Persisted per-identity token, minted on first use (tokenstore.rs:8-23)."""
     record = store.get(f"token-{agent_id}")
@@ -170,6 +160,9 @@ class SdaHttpClient(SdaService):
                              f"(expected one of {WIRE_CODECS})")
         #: set once any response carries the server's bin-codec advert
         self._peer_bin = False
+        #: cleared once a long-poll gets the old-server bare 404 — the
+        #: proxy then degrades to immediate-return polling permanently
+        self._peer_longpoll = True
         #: multi-tenant fairness (http/admission.py): when set to the
         #: recipient id this proxy's traffic belongs to, every request
         #: carries it as X-SDA-Tenant so the server's per-tenant budget
@@ -278,7 +271,8 @@ class SdaHttpClient(SdaService):
         return self.codec == "bin" or (self.codec == "auto" and self._peer_bin)
 
     def _request(self, method: str, path: str, *, params=None, json=None,
-                 data=None, headers=None, auth=None, stream=False):
+                 data=None, headers=None, auth=None, stream=False,
+                 timeout_s=None):
         """One logical operation: exponential-backoff retries around the
         raw HTTP exchange, bounded by ``max_retries`` AND the
         per-operation ``deadline``. Connection errors, timeouts, 5xx
@@ -321,7 +315,11 @@ class SdaHttpClient(SdaService):
                         response = self.session.request(
                             method, url, params=params, json=json, data=data,
                             auth=auth, headers=send_headers, stream=stream,
-                            timeout=min(self.timeout, max(0.05, remaining)),
+                            # timeout_s widens the socket timeout for ops
+                            # that legitimately idle server-side (a parked
+                            # long-poll); the op deadline still caps it
+                            timeout=min(timeout_s or self.timeout,
+                                        max(0.05, remaining)),
                         )
                     except requests.Timeout as e:
                         cause, error = "timeout", e
@@ -550,17 +548,16 @@ class SdaHttpClient(SdaService):
             raise NotFound(
                 f"unknown aggregation {participation.aggregation}")
 
-    def get_clerking_job(self, caller, clerk):
-        headers = None
-        if self.codec != "json":
-            # offer the binary codec for the bulkiest download of a round;
-            # an old server ignores the Accept header and answers JSON
-            headers = {"Accept":
-                       f"{bincodec.CONTENT_TYPE}, application/json"}
-        response = self._check(self._request(
-            "GET", "/v1/aggregations/any/jobs", headers=headers,
-            auth=self._auth(caller), stream=True,
-        ))
+    def _job_headers(self):
+        if self.codec == "json":
+            return None
+        # offer the binary codec for the bulkiest download of a round;
+        # an old server ignores the Accept header and answers JSON
+        return {"Accept": f"{bincodec.CONTENT_TYPE}, application/json"}
+
+    def _decode_job(self, response):
+        """Shared decode of a clerking-job response (immediate poll and
+        long-poll): negotiated codec + the X-Trace-Context job link."""
         if response is None:
             return None
         ctype = (response.headers.get("Content-Type") or "").split(";")[0].strip()
@@ -576,6 +573,47 @@ class SdaHttpClient(SdaService):
         if ctx is not None:
             obs.link_job(str(job.id), ctx)
         return job
+
+    def get_clerking_job(self, caller, clerk):
+        return self._decode_job(self._check(self._request(
+            "GET", "/v1/aggregations/any/jobs", headers=self._job_headers(),
+            auth=self._auth(caller), stream=True,
+        )))
+
+    def longpoll_supported(self) -> bool:
+        """Whether this peer still takes parked long-polls — False once
+        a bare 404 revealed an old server, at which point callers like
+        ``run_clerk`` must supply their own polling cadence (the
+        immediate-return fallback no longer sleeps server-side)."""
+        return bool(getattr(self, "_peer_longpoll", True))
+
+    def await_clerking_job(self, caller, clerk, wait_s: float = 0.0):
+        """Long-poll job delivery (``GET /v1/clerking-jobs?wait=S``,
+        docs/http.md): the server parks the request until a job exists
+        for this clerk, the wait expires (empty answer -> None), or the
+        worker drains (503 -> the retrying transport re-issues against a
+        live peer). Old servers without the route answer a bare 404: we
+        remember that (``http.longpoll.unsupported``) and fall back to
+        the immediate-return poll transparently, so mixed-version fleets
+        keep working. The socket timeout is widened past ``wait_s`` so a
+        healthy parked request is never reaped client-side."""
+        if not self.longpoll_supported():
+            return self.get_clerking_job(caller, clerk)
+        wait_s = max(0.0, float(wait_s))
+        try:
+            response = self._check(self._request(
+                "GET", "/v1/clerking-jobs", params={"wait": f"{wait_s:.3f}"},
+                headers=self._job_headers(), auth=self._auth(caller),
+                stream=True, timeout_s=wait_s + max(5.0, self.timeout),
+            ))
+        except NotFound:
+            # bare 404 (no X-Resource-Not-Found): an old server without
+            # the long-poll route — degrade to the classic poll for the
+            # rest of this proxy's life
+            self._peer_longpoll = False
+            metrics.count("http.longpoll.unsupported")
+            return self.get_clerking_job(caller, clerk)
+        return self._decode_job(response)
 
     def create_clerking_result(self, caller, result):
         self._post(

@@ -34,105 +34,32 @@ clients can distinguish a missing resource from a missing route
 
 from __future__ import annotations
 
-import base64
 import json
 import logging
-import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from ..protocol import (
-    Agent,
-    AgentId,
-    Aggregation,
-    AggregationId,
-    ClerkingJobId,
-    ClerkingResult,
-    Committee,
-    EncryptionKeyId,
-    InvalidCredentials,
-    InvalidRequest,
-    NotFound,
-    Participation,
-    ParticipationConflict,
-    PermissionDenied,
-    Profile,
-    SdaError,
-    Snapshot,
-    SnapshotId,
-    StoreUnavailable,
-    signed_encryption_key_from_obj,
-)
+from ..protocol import AgentId, InvalidRequest
 from ..protocol import bincodec
-from ..server import SdaServerService, auth_token
-from ..server import health as _health
-from ..server import lifecycle as _lifecycle
+from ..server import SdaServerService
 from ..server.routing import NODE_HEADER
 from ..utils import metrics
 from .. import chaos, obs
+from . import base
 from .admission import AdmissionControl, TENANT_HEADER
+#: Re-exports: the route table and label live in ``http/base.py`` now,
+#: shared with the async plane; existing importers keep working.
+from .base import REQUEST_ID_RE as _REQUEST_ID_RE  # noqa: F401
+from .base import ROUTE_TEMPLATES as _ROUTE_TEMPLATES  # noqa: F401
+from .base import route_label  # noqa: F401
 
 log = logging.getLogger(__name__)
 #: Dedicated child logger for the per-span trace lines, so ``sdad --trace``
 #: can unmute EXACTLY them without also unmuting the access log.
 trace_log = logging.getLogger(__name__ + ".trace")
-
-_ID = r"[0-9a-fA-F-]{36}"
-
-#: Every route template the dispatcher matches, ids collapsed to ``{id}``.
-#: Latency histograms are keyed by template (low cardinality by
-#: construction); anything else becomes ``unmatched`` so a scanner probing
-#: random paths cannot grow the histogram registry without bound.
-_ROUTE_TEMPLATES = frozenset({
-    "/v1/ping",
-    "/v1/agents/me",
-    "/v1/agents/{id}",
-    "/v1/agents/me/profile",
-    "/v1/agents/{id}/profile",
-    "/v1/agents/me/keys",
-    "/v1/agents/any/keys/{id}",
-    "/v1/aggregations",
-    "/v1/aggregations/{id}",
-    "/v1/aggregations/{id}/committee/suggestions",
-    "/v1/aggregations/implied/committee",
-    "/v1/aggregations/{id}/committee",
-    "/v1/aggregations/participations",
-    "/v1/aggregations/{id}/status",
-    "/v1/aggregations/{id}/round",
-    "/v1/aggregations/implied/snapshot",
-    "/v1/aggregations/any/jobs",
-    "/v1/aggregations/implied/jobs/{id}/result",
-    "/v1/aggregations/{id}/snapshots/{id}/result",
-    "/metrics",
-    "/statusz",
-})
-_ID_RE = re.compile(_ID)
-#: Charset a client-supplied X-Request-Id must satisfy to be echoed back
-#: (response-header injection hygiene).
-_REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._-]+")
-
-
-def _schedules_report(server) -> Optional[dict]:
-    """The ``/statusz`` schedules block (lazy import: the service plane
-    only loads when a scrape actually asks for it)."""
-    from ..service.scheduler import schedules_report
-
-    try:
-        return schedules_report(server)
-    except Exception:  # a third-party store without schedule support
-        return None
-
-
-def route_label(method: str, path: str) -> str:
-    """``GET /v1/agents/3f2a... -> "GET:/v1/agents/{id}"`` — the
-    per-route key under ``http.latency.<route>``."""
-    template = _ID_RE.sub("{id}", path)
-    if template not in _ROUTE_TEMPLATES:
-        return f"{method}:unmatched"
-    return f"{method}:{template}"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -149,24 +76,20 @@ class _Handler(BaseHTTPRequestHandler):
         return self.server.sda_service  # type: ignore[attr-defined]
 
     def _credentials(self) -> Optional[Tuple[AgentId, str]]:
-        header = self.headers.get("Authorization", "")
-        if not header.startswith("Basic "):
-            return None
-        try:
-            decoded = base64.b64decode(header[6:]).decode("utf-8")
-            agent_id, _, token = decoded.partition(":")
-            return AgentId(agent_id), token
-        except (ValueError, UnicodeDecodeError):
-            return None
+        return base.parse_basic_auth(self.headers.get("Authorization"))
 
-    def _authenticate(self) -> Agent:
-        creds = self._credentials()
-        if creds is None:
-            raise InvalidCredentials("missing Basic auth")
-        return self.service.server.check_auth_token(auth_token(*creds))
+    def _content_length(self) -> int:
+        """Negative (or garbage) Content-Length must 400, not turn
+        ``rfile.read`` into a blocking read-to-EOF that pins this
+        handler thread until the client hangs up."""
+        length = base.parse_content_length(
+            self.headers.get("Content-Length"))
+        if length < 0:
+            raise InvalidRequest("bad Content-Length")
+        return length
 
     def _raw_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length", 0))
+        length = self._content_length()
         raw = self.rfile.read(length) if length else b""
         self._body_consumed = True
         return raw
@@ -193,13 +116,50 @@ class _Handler(BaseHTTPRequestHandler):
         return (self._bin_enabled()
                 and bincodec.CONTENT_TYPE in (self.headers.get("Accept") or ""))
 
-    def _hot_body(self, decode_bin, from_obj):
+    def _hot_body(self, expect_tag, from_obj):
         """Decode a hot-route POST body by its content type: negotiated
         binary frame or the JSON fallback (old peers). Codec decode
-        errors raise ValueError -> 400, exactly like malformed JSON."""
+        errors raise ValueError -> 400, exactly like malformed JSON.
+
+        Binary bodies STREAM through the incremental decoder
+        (``bincodec.FeedDecoder``): chunks feed straight into the resource
+        under construction, so per-request memory is bounded by the
+        largest single field frame, not the whole dim-1e8 upload."""
         if self._body_is_bin():
             metrics.count("http.codec.bin.in")
-            return decode_bin(self._raw_body())
+            length = self._content_length()
+            self._body_consumed = True  # we own the body bytes from here
+            decoder = bincodec.FeedDecoder(expect_tag)
+            remaining = length
+            try:
+                while remaining:
+                    chunk = self.rfile.read(min(65536, remaining))
+                    if not chunk:
+                        self.close_connection = True
+                        raise ValueError("truncated x-sda-bin body")
+                    remaining -= len(chunk)
+                    decoder.feed(chunk)
+                return decoder.finish()
+            except ValueError:
+                # drain what's left so keep-alive framing survives the
+                # 400 — bounded, like _reply's drain: a client that
+                # advertised bytes and stalls forfeits the connection
+                # instead of pinning this thread
+                try:
+                    previous = self.connection.gettimeout()
+                    self.connection.settimeout(5.0)
+                    try:
+                        while remaining:
+                            chunk = self.rfile.read(min(65536, remaining))
+                            if not chunk:
+                                self.close_connection = True
+                                break
+                            remaining -= len(chunk)
+                    finally:
+                        self.connection.settimeout(previous)
+                except OSError:  # includes socket.timeout: framing lost
+                    self.close_connection = True
+                raise
         metrics.count("http.codec.json.in")
         return from_obj(self._json_body())
 
@@ -227,7 +187,11 @@ class _Handler(BaseHTTPRequestHandler):
         # parsed as the next request line — drain them first, but bounded:
         # a client that advertised a body and never sends it must not pin
         # this thread, so a stalled drain forfeits the connection instead
-        length = int(self.headers.get("Content-Length", 0) or 0)
+        length = base.parse_content_length(self.headers.get("Content-Length"))
+        if length < 0:
+            # garbage framing: nothing sane to drain, sever instead
+            length = 0
+            self.close_connection = True
         if length and not self._body_consumed:
             self._body_consumed = True
             try:
@@ -307,12 +271,6 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _reply_option(self, obj, extra_headers=None):
-        if obj is None:
-            self._reply(404, {"error": "resource not found"}, resource_not_found=True)
-        else:
-            self._reply(200, obj.to_obj(), extra_headers=extra_headers)
-
     _t0 = 0.0
     _counted = False
     _body_consumed = False
@@ -331,15 +289,7 @@ class _Handler(BaseHTTPRequestHandler):
         return str(self.client_address[0])
 
     def _tenant_key(self) -> Optional[str]:
-        """Per-tenant admission key: the CLAIMED recipient id from the
-        ``X-SDA-Tenant`` header (unverified, same trust model as the
-        agent key), token charset + bounded length so a hostile value
-        cannot grow the bucket dict with junk or smuggle bytes."""
-        claimed = self.headers.get(TENANT_HEADER, "")
-        if claimed and len(claimed) <= 64 \
-                and _REQUEST_ID_RE.fullmatch(claimed):
-            return claimed
-        return None
+        return base.tenant_key(self.headers.get(TENANT_HEADER))
 
     # -- dispatch ----------------------------------------------------------
     def _route(self, method: str):
@@ -360,51 +310,25 @@ class _Handler(BaseHTTPRequestHandler):
                 self.server.active_requests -= 1  # type: ignore[attr-defined]
 
     def _route_inner(self, method: str):
-        if getattr(self.server, "draining", False):
-            # graceful drain: the accept loop is already stopped, but an
-            # established keep-alive connection can still deliver a NEW
-            # request — turn it away before any auth/store work (a lease
-            # granted now would die with the process) and close the
-            # connection so the client reconnects against a live peer
-            self.close_connection = True
-            metrics.count("http.drain.rejected")
-            return self._reply(
-                503, {"error": "draining"},
-                extra_headers={"Connection": "close"}, retry_after=1.0,
-            )
         url = urlparse(self.path)
         path = url.path.rstrip("/")
         query = parse_qs(url.query)
         self._route_path = path or "/"
-        # correlation id: reuse the client's X-Request-Id, mint one else.
-        # The value is echoed into a response header, so a hostile one must
-        # not smuggle CRLFs or unbounded bytes: token charset, capped length
-        claimed = self.headers.get(obs.REQUEST_ID_HEADER, "")
-        if not (claimed and len(claimed) <= 64
-                and _REQUEST_ID_RE.fullmatch(claimed)):
-            claimed = obs.new_request_id()
-        self._request_id = claimed
-
-        # observability plane: exempt from admission (scrapes must land
-        # during the exact overload they are meant to diagnose) and from
-        # tracing (a scrape loop would churn the span ring buffer)
-        if method == "GET" and path == "/metrics":
-            if not getattr(self.server, "metrics_enabled", False):
-                return self._reply(404, {"error": "metrics endpoint disabled "
-                                                  "(sdad --metrics)"})
-            node_id = getattr(self.server, "node_id", None)
-            return self._reply(
-                200, raw=metrics.prometheus_text(
-                    labels={"node_id": node_id} if node_id else None
-                ).encode("utf-8"),
-                content_type="text/plain; version=0.0.4; charset=utf-8",
-            )
-        if method == "GET" and path == "/statusz":
-            statusz = getattr(self.server, "statusz_fn", None)
-            if statusz is None:
-                return self._reply(404, {"error": "statusz endpoint disabled "
-                                                  "(sdad --statusz)"})
-            return self._reply(200, statusz())
+        self._request_id = base.request_id(
+            self.headers.get(obs.REQUEST_ID_HEADER))
+        # draining (a keep-alive connection can still deliver a NEW
+        # request after the accept loop stopped — turn it away before
+        # any auth/store work) + the admission/tracing-exempt
+        # observability endpoints, shared with the async plane
+        pre = base.preroute_reply(self.server, method, path)
+        if pre is not None:
+            return self._send_reply(pre)
+        # protocol garbage pre-dispatch, matching the async plane's
+        # header-parse-time rejection: a negative Content-Length would
+        # otherwise turn body reads/drains into read-to-EOF stalls
+        if base.parse_content_length(self.headers.get("Content-Length")) < 0:
+            self.close_connection = True
+            return self._reply(400, {"error": "bad Content-Length"})
 
         # server span: joins the caller's trace when the request carries a
         # W3C traceparent header, else roots a fresh trace. Everything the
@@ -459,218 +383,42 @@ class _Handler(BaseHTTPRequestHandler):
                     )
 
     def _dispatch(self, method: str, path: str, query):
-        def m(pattern):
-            return re.fullmatch(pattern, path)
-
-        # failpoint: transient transport trouble BEFORE any service work —
-        # injected 500s, response delays, or hard connection drops. The
-        # claimed agent id rides the ctx so a `partition` spec can sever
-        # exactly one agent<->server pair (agent=<id>)
-        action = chaos.evaluate(
-            "http.server.request",
-            ctx={"agent": self._agent_key()} if chaos.registry.active()
-            else None)
-        if action is not None:
-            if action.kind == "error":
-                return self._reply(500, {"error": str(action.exc)})
-            if action.kind == "drop":
-                log.info("%s %s -> chaos-dropped connection", self.command, self.path)
-                self.close_connection = True
-                return
-            time.sleep(action.delay_s)  # "delay": proceed after the stall
-
-        try:
-            if method == "GET" and path == "/v1/ping":
-                return self._reply(200, self.service.ping().to_obj())
-
-            if method == "POST" and path == "/v1/agents/me":
-                return self._create_agent()
-
-            caller = self._authenticate()
-
-            if r := m(rf"/v1/agents/({_ID})/profile"):
-                if method == "GET":
-                    return self._reply_option(
-                        self.service.get_profile(caller, AgentId(r.group(1)))
-                    )
-            if method == "POST" and path == "/v1/agents/me/profile":
-                profile = Profile.from_obj(self._json_body())
-                self.service.upsert_profile(caller, profile)
-                return self._reply(200)
-            if r := m(rf"/v1/agents/any/keys/({_ID})"):
-                if method == "GET":
-                    return self._reply_option(
-                        self.service.get_encryption_key(
-                            caller, EncryptionKeyId(r.group(1))
-                        )
-                    )
-            if method == "POST" and path == "/v1/agents/me/keys":
-                key = signed_encryption_key_from_obj(self._json_body())
-                self.service.create_encryption_key(caller, key)
-                return self._reply(201)
-            if r := m(rf"/v1/agents/({_ID})"):
-                if method == "GET":
-                    return self._reply_option(
-                        self.service.get_agent(caller, AgentId(r.group(1)))
-                    )
-
-            if path == "/v1/aggregations" and method == "GET":
-                title = query.get("title", [None])[0]
-                recipient = query.get("recipient", [None])[0]
-                ids = self.service.list_aggregations(
-                    caller,
-                    filter=title,
-                    recipient=None if recipient is None else AgentId(recipient),
-                )
-                return self._reply(200, [str(i) for i in ids])
-            if path == "/v1/aggregations" and method == "POST":
-                agg = Aggregation.from_obj(self._json_body())
-                self.service.create_aggregation(caller, agg)
-                return self._reply(201)
-            if r := m(rf"/v1/aggregations/({_ID})/committee/suggestions"):
-                if method == "GET":
-                    candidates = self.service.suggest_committee(
-                        caller, AggregationId(r.group(1))
-                    )
-                    return self._reply(200, [c.to_obj() for c in candidates])
-            if path == "/v1/aggregations/implied/committee" and method == "POST":
-                committee = Committee.from_obj(self._json_body())
-                self.service.create_committee(caller, committee)
-                return self._reply(201)
-            if r := m(rf"/v1/aggregations/({_ID})/committee"):
-                if method == "GET":
-                    return self._reply_option(
-                        self.service.get_committee(caller, AggregationId(r.group(1)))
-                    )
-            if path == "/v1/aggregations/participations" and method == "POST":
-                participation = self._hot_body(
-                    bincodec.decode_participation, Participation.from_obj)
-                self.service.create_participation(caller, participation)
-                return self._reply(201)
-            if r := m(rf"/v1/aggregations/({_ID})/status"):
-                if method == "GET":
-                    return self._reply_option(
-                        self.service.get_aggregation_status(
-                            caller, AggregationId(r.group(1))
-                        )
-                    )
-            if r := m(rf"/v1/aggregations/({_ID})/round"):
-                if method == "GET":
-                    # round lifecycle state (server/lifecycle.py): what a
-                    # blocking client polls instead of result_ready alone —
-                    # terminal failed/expired states carry the diagnosis
-                    return self._reply_option(
-                        self.service.get_round_status(
-                            caller, AggregationId(r.group(1))
-                        )
-                    )
-            if path == "/v1/aggregations/implied/snapshot" and method == "POST":
-                snap = Snapshot.from_obj(self._json_body())
-                self.service.create_snapshot(caller, snap)
-                return self._reply(201)
-            if path == "/v1/aggregations/any/jobs" and method == "GET":
-                job = self.service.get_clerking_job(caller, caller.id)
-                headers = None
-                if job is not None:
-                    # hand the clerk the trace context the job was enqueued
-                    # under: processing (even after a lease reissue) parents
-                    # to the round that created the job, not the poll
-                    link = obs.job_link(str(job.id))
-                    if link is not None:
-                        headers = {obs.TRACE_CONTEXT_HEADER:
-                                   obs.format_traceparent(link)}
-                if job is not None and self._accepts_bin():
-                    # negotiated response codec: the job payload is the
-                    # bulkiest download of a round (a whole clerk column)
-                    metrics.count("http.codec.bin.out")
-                    return self._reply(
-                        200, raw=bincodec.encode_clerking_job(job),
-                        content_type=bincodec.CONTENT_TYPE,
-                        extra_headers=headers,
-                    )
-                return self._reply_option(job, extra_headers=headers)
-            if r := m(rf"/v1/aggregations/implied/jobs/({_ID})/result"):
-                if method == "POST":
-                    result = self._hot_body(
-                        bincodec.decode_clerking_result, ClerkingResult.from_obj)
-                    if str(result.job) != r.group(1).lower():
-                        raise InvalidRequest("result job id does not match route")
-                    self.service.create_clerking_result(caller, result)
-                    return self._reply(201)
-            if r := m(rf"/v1/aggregations/({_ID})/snapshots/({_ID})/result"):
-                if method == "GET":
-                    return self._reply_option(
-                        self.service.get_snapshot_result(
-                            caller, AggregationId(r.group(1)), SnapshotId(r.group(2))
-                        )
-                    )
-            if r := m(rf"/v1/aggregations/({_ID})"):
-                if method == "GET":
-                    return self._reply_option(
-                        self.service.get_aggregation(caller, AggregationId(r.group(1)))
-                    )
-                if method == "DELETE":
-                    self.service.delete_aggregation(caller, AggregationId(r.group(1)))
-                    return self._reply(200)
-
-            return self._reply(404, {"error": "no such route"})
-
-        except InvalidCredentials as e:
-            return self._reply(401, {"error": str(e)})
-        except PermissionDenied as e:
-            return self._reply(403, {"error": str(e)})
-        except (InvalidRequest, ValueError, KeyError, TypeError) as e:
-            return self._reply(400, {"error": f"{type(e).__name__}: {e}"})
-        except NotFound as e:
-            return self._reply(404, {"error": str(e)}, resource_not_found=True)
-        except ParticipationConflict as e:
-            # exactly-once ingestion rejected an equivocating upload: 409
-            # is TERMINAL for the retrying transport (re-sending the same
-            # conflicting bytes can never succeed), unlike the transient
-            # 5xx/429 family. No stack trace — detection is the feature
-            # working, and a buggy device would flood the log.
-            return self._reply(409, {"error": str(e)})
-        except StoreUnavailable as e:
-            # breaker-open shed (server/breaker.py): the store was never
-            # touched — 503 + Retry-After, same contract as admission
-            # sheds, so the retrying transport backs off and resubmits.
-            # No stack trace: an open breaker shedding is WORKING, and a
-            # brownout would otherwise flood the log at request rate.
-            metrics.count("http.store_unavailable")
+        """One request through the shared route table (``http/base.py``):
+        build the transport adapter, dispatch, park long-polls on this
+        request thread, then write the decided reply."""
+        rx = _HandlerExchange(self, method, path, query)
+        reply = base.dispatch(self.service, rx)
+        if reply.park is not None:
+            # long-poll: block THIS request thread (the threaded plane's
+            # park) until a job lands, the wait expires, or drain wakes
+            # us — the admission slot and the active-request census both
+            # cover the parked time, which is what drain waits on
             if self._span is not None:
-                self._span.set_attribute("store_unavailable", True)
-            return self._reply(503, {"error": str(e)},
-                               retry_after=e.retry_after)
-        except SdaError as e:
-            log.exception("server error")
-            return self._reply(500, {"error": str(e)})
-        except Exception as e:  # don't kill the connection thread
-            log.exception("unexpected server error")
-            return self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                self._span.set_attribute("longpoll.parked", True)
+            reply = base.blocking_park(
+                self.service, reply.park,
+                draining=lambda: getattr(self.server, "draining", False),
+                fleet_peers=getattr(self.server, "fleet_peers", None))
+        self._send_reply(reply)
 
-    def _create_agent(self):
-        """Agent self-registration also records the presented token
-        (lib.rs:192-201)."""
-        creds = self._credentials()
-        if creds is None:
-            raise InvalidCredentials("agent creation requires Basic auth")
-        agent_id, token = creds
-        if not token:
-            raise InvalidCredentials("empty token")
-        agent = Agent.from_obj(self._json_body())
-        if agent.id != agent_id:
-            raise PermissionDenied("auth username must match agent id")
-        # record-or-verify the token before the ACL'd create
-        try:
-            known = self.service.server.check_auth_token(auth_token(agent_id, token))
-        except InvalidCredentials:
-            if self.service.server.auth_tokens_store.get_auth_token(agent_id) is not None:
-                raise  # token exists but differs: reject
-            known = None
-        if known is None:
-            self.service.server.upsert_auth_token(auth_token(agent_id, token))
-        self.service.create_agent(agent, agent)
-        return self._reply(201)
+    def _send_reply(self, reply) -> None:
+        if reply.drop:
+            # chaos "drop": sever without response bytes
+            log.info("%s %s -> chaos-dropped connection",
+                     self.command, self.path)
+            self.close_connection = True
+            return
+        if reply.span_attrs and self._span is not None:
+            for key, value in reply.span_attrs.items():
+                self._span.set_attribute(key, value)
+        if reply.close:
+            self.close_connection = True
+        self._reply(
+            reply.status, reply.obj, raw=reply.raw,
+            content_type=reply.content_type,
+            resource_not_found=reply.resource_not_found,
+            retry_after=reply.retry_after, extra_headers=reply.headers,
+        )
 
     def do_GET(self):
         self._route("GET")
@@ -680,6 +428,37 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         self._route("DELETE")
+
+
+class _HandlerExchange:
+    """The threaded plane's transport adapter for ``base.dispatch``:
+    thin delegation onto the live ``BaseHTTPRequestHandler``."""
+
+    __slots__ = ("_h", "method", "path", "query")
+
+    def __init__(self, handler: _Handler, method: str, path: str, query):
+        self._h = handler
+        self.method = method
+        self.path = path
+        self.query = query
+
+    def header(self, name: str):
+        return self._h.headers.get(name)
+
+    def json_body(self):
+        return self._h._json_body()
+
+    def hot_body(self, expect_tag, from_obj):
+        return self._h._hot_body(expect_tag, from_obj)
+
+    def accepts_bin(self) -> bool:
+        return self._h._accepts_bin()
+
+    def credentials(self):
+        return self._h._credentials()
+
+    def agent_key(self) -> str:
+        return self._h._agent_key()
 
 
 class SdaHttpServer:
@@ -750,76 +529,15 @@ class SdaHttpServer:
         self._thread: Optional[threading.Thread] = None
 
     def statusz(self) -> dict:
-        """The ``GET /statusz`` payload: liveness + capacity + device-perf
-        state in one scrape (served only when the endpoint is enabled —
-        like ``/metrics`` it reveals traffic shape)."""
-        from ..obs import devprof
-
-        service = self.httpd.sda_service  # type: ignore[attr-defined]
-        gauges = metrics.gauge_report("http.inflight")
-        # unwrap a breaker proxy: the page names the BACKEND, not the wrap
-        agents_store = getattr(service.server.agents_store, "_inner",
-                               service.server.agents_store)
-        return {
-            "node_id": self.node_id,
-            "fleet": {
-                "peers": metrics.gauge_report("fleet.peers").get(
-                    "fleet.peers", 1 if self.node_id else 0),
-            },
-            "uptime_s": round(time.time() - self._started_at, 3),
-            # backend module name ("memory"/"sqlite"/"jsonfs"/"mongo")
-            "store": type(agents_store).__module__.rsplit(".", 1)[-1],
-            "inflight": gauges.get("http.inflight", 0),
-            "inflight_peak": gauges.get("http.inflight.peak", 0),
-            "admission_enabled": self.admission.enabled,
-            # multi-tenant fairness verdicts (http/admission.py): which
-            # tenants were admitted/shed against their own budgets —
-            # present only when the per-tenant layer is armed
-            "admission": (self.admission.tenants_report()
-                          if self.admission.tenant_rate is not None
-                          else None),
-            "requests": self.status_counts,
-            # which wire the peers actually spoke (fleet loadgen reads
-            # the negotiated outcome from here — the counters live in
-            # THIS process, not the driver's)
-            "codec_counters": metrics.counter_report("http.codec.") or {},
-            "lease": {
-                "lease_seconds": service.server.clerking_lease_seconds,
-                "counters": metrics.counter_report("server.job."),
-            },
-            # contended-idempotency visibility: how often this worker's
-            # snapshot pipeline won, lost, or converged on a peer's freeze
-            "snapshot": metrics.counter_report("server.snapshot.") or {},
-            # exactly-once ingestion visibility: created vs byte-identical
-            # replays vs rejected equivocations (fleet loadgen sums these
-            # across scrapes — the counters live in THIS process)
-            "participation": metrics.counter_report(
-                "server.participation.") or {},
-            # round lifecycle table (server/lifecycle.py): per-state and
-            # per-tenant tallies + the most recently updated LIVE rounds
-            # (terminal history only pads the remainder) — the fleet's
-            # shared-store view, so any worker's scrape shows every round
-            "rounds": _lifecycle.rounds_report(service.server),
-            # recurring-round schedules (service/scheduler.py): every
-            # installed schedule's tenant, current epoch and cadence —
-            # also the shared-store view
-            "schedules": _schedules_report(service.server),
-            # live fleet health table (server/health.py): every worker's
-            # heartbeat state and age, read from the shared store — any
-            # worker's scrape shows the whole fleet
-            "fleet_health": _health.fleet_health_report(
-                service.server.clerking_job_store),
-            # store circuit breaker (server/breaker.py): present only
-            # when armed (sdad --store-breaker)
-            "breaker": (service.server.store_breaker.report()
-                        if getattr(service.server, "store_breaker", None)
-                        is not None else None),
-            # fleet drills arm failpoints per worker (sdad --chaos-spec);
-            # the scrape proves the faults actually fired in THIS process
-            "failpoints": chaos.report() or {},
-            "devprof": devprof.compile_totals(),
-            "hbm": metrics.gauge_report("device.hbm."),
-        }
+        """The ``GET /statusz`` payload, built by the shared
+        ``base.build_statusz`` so fleet-mode counter aggregation reads
+        identical fields off either HTTP plane."""
+        return base.build_statusz(
+            self.httpd.sda_service,  # type: ignore[attr-defined]
+            node_id=self.node_id, admission=self.admission,
+            started_at=self._started_at, status_counts=self.status_counts,
+            plane="threaded",
+        )
 
     def configure_admission(
         self,
@@ -835,6 +553,13 @@ class SdaHttpServer:
             max_inflight=max_inflight, rate=rate_limit, burst=rate_burst,
             tenant_rate=tenant_rate, tenant_burst=tenant_burst,
         )
+
+    @property
+    def sda_service(self) -> SdaServerService:
+        """The wrapped service — uniform across both planes (the async
+        plane exposes the same attribute), so drivers and tests can
+        reach ``server.sda_service.server`` without knowing the plane."""
+        return self.httpd.sda_service  # type: ignore[attr-defined]
 
     @property
     def status_counts(self) -> dict:
@@ -863,27 +588,21 @@ class SdaHttpServer:
         # from here on), then stop the accept/serve loop and wait out the
         # requests that were already in flight
         self.httpd.draining = True  # type: ignore[attr-defined]
+        service = self.httpd.sda_service  # type: ignore[attr-defined]
+        # wake every parked long-poll NOW: a parked clerk must get its
+        # 503 + Connection: close immediately (and count as finished
+        # in-flight work below), not hold the drain to its wait timeout
+        wakeup = getattr(service.server, "job_wakeup", None)
+        if wakeup is not None:
+            wakeup.notify_all()
         self.httpd.shutdown()  # blocks until the serve loop exits
         deadline = time.monotonic() + grace_s
         while self.active_requests and time.monotonic() < deadline:
             time.sleep(0.02)
         stranded = self.active_requests
-        service = self.httpd.sda_service  # type: ignore[attr-defined]
-        released = service.server.release_held_leases()
+        summary = base.drain_summary(service, node_id=self.node_id,
+                                     stranded=stranded)
         self.shutdown()  # joins the (already finished) serve-loop thread
-        if stranded:
-            # a handler still running past the grace window is an
-            # abandoned request — the process exits right after and
-            # kills its daemon thread mid-flight. That IS the leak the
-            # fleet contract gates on.
-            metrics.count("http.shutdown.leaked", stranded)
-        summary = {
-            "node_id": self.node_id,
-            "released_leases": released,
-            "stranded_requests": stranded,
-            "leaked": stranded,
-        }
-        log.info("drained: %s", summary)
         return summary
 
     @property

@@ -8,17 +8,20 @@ server's admission control. Entry points: ``sda-sim --load`` (CLI) and
 ``run_load`` (tests, notebooks). ``docs/load.md`` has the tuning guide.
 """
 
+from .connstorm import ConnstormProfile, run_connstorm
 from .driver import (
     LoadProfile,
     latency_report_ms,
     run_fleet_scaling,
     run_load,
 )
+from .pickup import PickupProfile, run_pickup_bench
 
 # ``inputbench`` (the participation input-path micro-bench behind
 # ``python -m sda_tpu.loadgen.inputbench``) is intentionally NOT imported
 # eagerly: importing a ``-m`` target from its package __init__ trips
 # runpy's double-import warning. ``from sda_tpu.loadgen.inputbench import
 # run_input_bench`` for programmatic use.
-__all__ = ["LoadProfile", "latency_report_ms", "run_fleet_scaling",
-           "run_load"]
+__all__ = ["ConnstormProfile", "LoadProfile", "PickupProfile",
+           "latency_report_ms", "run_connstorm", "run_fleet_scaling",
+           "run_load", "run_pickup_bench"]

@@ -100,6 +100,10 @@ class LoadProfile:
     # heartbeats this often and runs the failure detector (dead after 4
     # intervals) — the report's fleet_health table shows the live verdict
     heartbeat_s: Optional[float] = None
+    # serve on the asyncio event-loop plane (http/aserver.py) instead of
+    # thread-per-connection; fleet mode passes `sdad --async`. The wire
+    # contract is identical — ci.sh pins fixed-seed A/B bit-exactness
+    async_http: bool = False
 
 
 def _percentiles_ms(summary: dict) -> dict:
@@ -201,6 +205,8 @@ def run_load(profile: LoadProfile) -> dict:
         # path arms admission/chaos AFTER setup — fleet setup traffic is
         # tiny, so whole-run arming keeps the workers stateless.
         extra = ["--job-lease", str(profile.lease_seconds), "--statusz"]
+        if profile.async_http:
+            extra += ["--async"]
         if profile.heartbeat_s is not None:
             # the gray-failure plane: heartbeats + the failure detector
             # riding each worker's sweeper (suspect at 2 intervals, dead
@@ -238,7 +244,10 @@ def run_load(profile: LoadProfile) -> dict:
             raise ValueError(f"unknown store {profile.store!r}")
         service_impl.server.clerking_lease_seconds = profile.lease_seconds
 
-        http_server = SdaHttpServer(service_impl, bind="127.0.0.1:0")
+        from ..http import server_class
+
+        http_server = server_class(profile.async_http)(
+            service_impl, bind="127.0.0.1:0")
         http_server.start_background()
     # churned devices journal to a real directory — resume reads it as a
     # fresh process would (exactly-once participation, docs/robustness.md)
@@ -563,6 +572,20 @@ def run_load(profile: LoadProfile) -> dict:
     lag_summary = metrics.histogram_report("load.lag").get("load.lag")
     clerk_job_summary = metrics.histogram_report("clerk.job.").get(
         "clerk.job.seconds")
+    # enqueue->lease latency (server.job.pickup): stamped in the server
+    # process — live metrics in-process, per-node statusz blocks in fleet
+    # mode (the long-poll plane's headline; docs/load.md)
+    if fleet is not None:
+        pickup_ms = {
+            node: (doc.get("lease") or {}).get("pickup_ms")
+            for node, doc in final_scrapes.items()
+            if (doc.get("lease") or {}).get("pickup_ms")
+        } or None
+    else:
+        pickup_summary = metrics.histogram_report("server.job.pickup").get(
+            "server.job.pickup")
+        pickup_ms = (_percentiles_ms(pickup_summary)
+                     if pickup_summary else None)
     requests_total = sum(status_counts.values())
     shed = sum(v for k, v in status_counts.items() if k == 429)
     errors_5xx = sum(v for k, v in status_counts.items() if k >= 500)
@@ -594,6 +617,8 @@ def run_load(profile: LoadProfile) -> dict:
         "participants": profile.participants,
         "dim": profile.dim,
         "clerks": scheme.share_count,
+        # which serving transport handled the run (docs/scaling.md)
+        "http_plane": "async" if profile.async_http else "threaded",
         # the wire the swarm actually spoke (an "auto" run that upgraded
         # records "bin"): the regression gate keys comparability on this,
         # so it must name the negotiated outcome, not the requested mode
@@ -647,6 +672,8 @@ def run_load(profile: LoadProfile) -> dict:
         # pipeline moves
         "clerk_job_ms": (_percentiles_ms(clerk_job_summary)
                          if clerk_job_summary else None),
+        # enqueue->lease latency: the polling-vs-long-poll BENCH headline
+        "job_pickup_ms": pickup_ms,
         "lag_ms": _percentiles_ms(lag_summary) if lag_summary else None,
         # device-churn block (LoadProfile.churn): how many participants
         # crashed + rejoined, and the server's exactly-once verdict on
@@ -751,6 +778,7 @@ def run_fleet_scaling(profile: LoadProfile, nodes: int,
         "unit": "requests/sec",
         "platform": "cpu",  # the serving plane is a host-tier workload
         "host_cores": os.cpu_count(),
+        "http_plane": top["http_plane"],
         "codec": top["codec"],
         "seed": profile.seed,
         "chaos_rate": profile.chaos_rate,

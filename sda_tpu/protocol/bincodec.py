@@ -293,3 +293,197 @@ def decode(raw: bytes):
     if decoder is None:
         raise ValueError(f"unknown resource tag {tag}")
     return decoder(raw)
+
+
+# ---------------------------------------------------------------------------
+# Incremental (feed-based) decode — the streaming wire path
+#
+# The one-shot decoders above need the whole body in memory before the
+# first field parses; at production dimension that doubles a request's
+# peak memory (raw bytes + decoded arrays side by side) and, worse, forces
+# the HTTP planes to buffer entire dim-1e8 uploads per connection. The
+# FeedDecoder consumes the same wire format chunk by chunk: completed
+# fields (ids, one encryption blob at a time) move straight into the
+# resource under construction and their raw bytes are released, so the
+# transient buffer is bounded by the largest SINGLE field frame plus one
+# network chunk — O(frame), not O(body) — regardless of how many clerk
+# encryptions the upload carries.
+#
+# The parsers are generators speaking a tiny pull protocol: ``yield n``
+# returns exactly n bytes once the driver has them. They mirror the
+# one-shot decoders field for field; tests pin chunked == one-shot on
+# golden payloads at every chunk size.
+
+def _g_leb():
+    n = shift = 0
+    while True:
+        b = (yield 1)[0]
+        if shift > 63:
+            raise ValueError("oversized varint in x-sda-bin payload")
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n
+        shift += 7
+
+
+def _g_array():
+    tag = (yield 1)[0]
+    if tag >= len(_DTYPES):
+        raise ValueError(f"unknown array dtype tag {tag}")
+    nbytes = yield from _g_leb()
+    payload = yield nbytes
+    dtype = np.dtype(_DTYPES[tag])
+    if nbytes % dtype.itemsize:
+        raise ValueError("array byte length not a multiple of its itemsize")
+    return np.frombuffer(payload, dtype=dtype)
+
+
+def _g_bytes():
+    arr = yield from _g_array()
+    if arr.dtype != np.uint8:
+        raise ValueError("expected a u1 byte frame")
+    return arr.tobytes()
+
+
+def _g_uuid(cls):
+    raw = yield 16
+    return cls(_uuid.UUID(bytes=bytes(raw)))
+
+
+def _g_encryption():
+    variant = (yield 1)[0]
+    if variant >= len(_ENC_VARIANTS):
+        raise ValueError(f"unknown encryption variant tag {variant}")
+    data = yield from _g_bytes()
+    return Encryption(_ENC_VARIANTS[variant], Binary(data))
+
+
+def _g_header(want_tag):
+    head = yield 6
+    if bytes(head[:4]) != MAGIC:
+        raise ValueError("not an x-sda-bin payload (bad magic)")
+    if head[4] != VERSION:
+        raise ValueError(f"unsupported x-sda-bin version {head[4]}")
+    tag = head[5]
+    if want_tag is not None and tag != want_tag:
+        raise ValueError(f"unexpected resource tag {tag} (want {want_tag})")
+    return tag
+
+
+def _g_participation():
+    pid = yield from _g_uuid(ParticipationId)
+    participant = yield from _g_uuid(AgentId)
+    aggregation = yield from _g_uuid(AggregationId)
+    present = (yield 1)[0]
+    if present not in (0, 1):
+        raise ValueError("malformed option byte")
+    recipient_encryption = None
+    if present:
+        recipient_encryption = yield from _g_encryption()
+    count = yield from _g_leb()
+    clerk_encryptions = []
+    for _ in range(count):
+        clerk_id = yield from _g_uuid(AgentId)
+        enc = yield from _g_encryption()
+        clerk_encryptions.append((clerk_id, enc))
+    return Participation(
+        id=pid, participant=participant, aggregation=aggregation,
+        recipient_encryption=recipient_encryption,
+        clerk_encryptions=clerk_encryptions,
+    )
+
+
+def _g_clerking_job():
+    jid = yield from _g_uuid(ClerkingJobId)
+    clerk = yield from _g_uuid(AgentId)
+    aggregation = yield from _g_uuid(AggregationId)
+    snapshot = yield from _g_uuid(SnapshotId)
+    count = yield from _g_leb()
+    encryptions = []
+    for _ in range(count):
+        enc = yield from _g_encryption()
+        encryptions.append(enc)
+    return ClerkingJob(id=jid, clerk=clerk, aggregation=aggregation,
+                       snapshot=snapshot, encryptions=encryptions)
+
+
+def _g_clerking_result():
+    job = yield from _g_uuid(ClerkingJobId)
+    clerk = yield from _g_uuid(AgentId)
+    encryption = yield from _g_encryption()
+    return ClerkingResult(job=job, clerk=clerk, encryption=encryption)
+
+
+_G_PARSERS = {
+    TAG_PARTICIPATION: _g_participation,
+    TAG_CLERKING_JOB: _g_clerking_job,
+    TAG_CLERKING_RESULT: _g_clerking_result,
+}
+
+
+def _g_resource(want_tag):
+    tag = yield from _g_header(want_tag)
+    parser = _G_PARSERS.get(tag)
+    if parser is None:
+        raise ValueError(f"unknown resource tag {tag}")
+    result = yield from parser()
+    return result
+
+
+class FeedDecoder:
+    """Incremental ``x-sda-bin`` decoder: ``feed()`` body chunks as they
+    arrive, ``finish()`` once the body is done.
+
+    Malformed input raises ``ValueError`` from the offending ``feed`` (or
+    from ``finish`` for truncation/trailing bytes) — the same error
+    contract as the one-shot decoders, so the HTTP layer's 400 mapping
+    is unchanged. ``expect_tag`` pins the resource kind the route expects
+    (a participation POST must not decode as a clerking result)."""
+
+    __slots__ = ("_buf", "_gen", "_want", "_result", "_done", "fed_bytes")
+
+    def __init__(self, expect_tag: Optional[int] = None):
+        self._buf = bytearray()
+        self._gen = _g_resource(expect_tag)
+        self._want = self._gen.send(None)
+        self._result = None
+        self._done = False
+        #: total body bytes consumed (request accounting/logging)
+        self.fed_bytes = 0
+
+    def feed(self, chunk: bytes) -> None:
+        if not chunk:
+            return
+        self.fed_bytes += len(chunk)
+        if self._done:
+            raise ValueError("trailing bytes after x-sda-bin payload")
+        self._buf += chunk
+        while not self._done and len(self._buf) >= self._want:
+            piece = bytes(self._buf[:self._want])
+            del self._buf[:self._want]
+            try:
+                self._want = self._gen.send(piece)
+            except StopIteration as stop:
+                self._result = stop.value
+                self._done = True
+        if self._done and self._buf:
+            raise ValueError("trailing bytes after x-sda-bin payload")
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def finish(self):
+        """The decoded resource; raises if the stream ended early."""
+        if not self._done:
+            raise ValueError("truncated x-sda-bin payload")
+        return self._result
+
+
+def decode_stream(chunks, expect_tag: Optional[int] = None):
+    """Decode an iterable of body chunks incrementally (the threaded HTTP
+    plane's streaming read path); returns the resource."""
+    decoder = FeedDecoder(expect_tag)
+    for chunk in chunks:
+        decoder.feed(chunk)
+    return decoder.finish()

@@ -46,6 +46,7 @@ from .. import obs
 from ..utils import metrics
 from . import lifecycle
 from . import snapshot as snapshot_mod
+from .wakeup import JobWakeup, clamp_wait, longpoll_tick
 from .stores import (
     AgentsStore,
     AggregationsStore,
@@ -93,6 +94,19 @@ class SdaServer:
         # what graceful drain hands back to the fleet (release_held_leases)
         self._granted_leases: dict = {}
         self._granted_lock = threading.Lock()
+        #: long-poll push plane (server/wakeup.py): snapshot fan-out,
+        #: drain lease handback and dead-worker lease recall notify the
+        #: clerks that might now have work, so a parked
+        #: ``GET /v1/clerking-jobs?wait=S`` wakes immediately instead of
+        #: polling the store. Per-process: cross-worker events degrade to
+        #: the long-poll re-check tick.
+        self.job_wakeup = JobWakeup()
+        # enqueue stamps for the server.job.pickup histogram: job id ->
+        # monotonic enqueue time, observed (and popped) when THIS worker
+        # grants the lease. A job picked up via a fleet peer has no stamp
+        # here — counted, not observed (the latency is unknowable locally).
+        self._job_enqueued_at: dict = {}
+        self._job_enqueued_lock = threading.Lock()
         #: straggler hedging (server/health.py): when set to a staleness
         #: threshold in seconds, an empty lease poll may hedge a job whose
         #: holder's heartbeat is that stale — the hedged copy races the
@@ -252,6 +266,65 @@ class SdaServer:
                 metrics.count("server.snapshot.created")
 
     # -- clerking ----------------------------------------------------------
+    def note_jobs_enqueued(self, job_ids) -> None:
+        """Stamp the enqueue instant of freshly fanned-out clerking jobs
+        (snapshot.py) so the grant path can observe enqueue->lease latency
+        as the ``server.job.pickup`` histogram — the metric the long-poll
+        plane exists to collapse (docs/load.md). Bounded: past the size
+        threshold, aged-out stamps (jobs granted via a peer) are swept
+        and the oldest evicted, so fleet-mode fan-out faster than the
+        age cutoff still can't grow the table or turn every fan-out into
+        an O(table) rebuild."""
+        now = time.monotonic()
+        with self._job_enqueued_lock:
+            if len(self._job_enqueued_at) >= 4096:
+                cutoff = now - 600.0
+                self._job_enqueued_at = {
+                    j: t for j, t in self._job_enqueued_at.items()
+                    if t > cutoff
+                }
+                overflow = len(self._job_enqueued_at) - 4096
+                if overflow > 0:
+                    stamps = self._job_enqueued_at
+                    for job in sorted(stamps, key=stamps.get)[:overflow]:
+                        del stamps[job]
+            for job_id in job_ids:
+                self._job_enqueued_at[job_id] = now
+
+    def _observe_pickup(self, job_id) -> None:
+        with self._job_enqueued_lock:
+            enqueued = self._job_enqueued_at.pop(job_id, None)
+        if enqueued is not None:
+            metrics.observe("server.job.pickup", time.monotonic() - enqueued)
+        else:
+            # granted here, enqueued elsewhere (a fleet peer's fan-out or
+            # a pre-restart round): the latency is unknowable locally
+            metrics.count("server.job.pickup_unstamped")
+
+    def sweep_granted_leases(self, now: Optional[float] = None) -> int:
+        """Drop lapsed entries from the per-worker granted-lease table —
+        a result posted via a PEER worker (or a lapsed lease a peer
+        reissued) never comes back through this worker's create_result,
+        so lapsed entries would otherwise accumulate forever. Shared by
+        both HTTP planes (grant path + /statusz), so fleet-mode lease
+        accounting cannot drift between implementations. Returns how many
+        entries were swept."""
+        now = time.time() if now is None else now
+        with self._granted_lock:
+            before = len(self._granted_leases)
+            self._granted_leases = {
+                j: ce for j, ce in self._granted_leases.items()
+                if ce[1] > now
+            }
+            return before - len(self._granted_leases)
+
+    def held_lease_count(self) -> int:
+        """Live (unlapsed) leases this worker currently holds — the
+        shared /statusz figure for both HTTP planes."""
+        self.sweep_granted_leases()
+        with self._granted_lock:
+            return len(self._granted_leases)
+
     def _suspect_nodes(self) -> list:
         """Fleet workers that currently look unhealthy (stale heartbeat or
         an explicit suspect mark) — the hedging plane's shadow-execution
@@ -300,23 +373,17 @@ class SdaServer:
                     poll_span.set_attribute("leased", True)
                     metrics.count("server.job.leased")
                     with self._granted_lock:
-                        if len(self._granted_leases) >= 256:
-                            # opportunistic sweep: a result posted via a
-                            # PEER worker (or a lapsed lease a peer
-                            # reissued) never comes back through this
-                            # worker's create_result, so lapsed entries
-                            # would otherwise accumulate forever
-                            now = time.time()
-                            self._granted_leases = {
-                                j: ce
-                                for j, ce in self._granted_leases.items()
-                                if ce[1] > now
-                            }
+                        oversized = len(self._granted_leases) >= 256
+                    if oversized:
+                        self.sweep_granted_leases()
+                    with self._granted_lock:
                         self._granted_leases[job.id] = (clerk, expires)
             else:
                 job = self.clerking_job_store.poll_clerking_job(clerk)
             if job is not None:
                 poll_span.set_attribute("job", str(job.id))
+                # enqueue->lease latency: the polling-vs-long-poll headline
+                self._observe_pickup(job.id)
             metrics.count("server.job.polled" if job else "server.job.poll_empty")
             return job
 
@@ -372,6 +439,10 @@ class SdaServer:
                 continue
         if released:
             metrics.count("server.job.lease_released_on_drain", released)
+            # same-process clerks parked on a long-poll should pick the
+            # handed-back work up immediately; fleet peers' parked polls
+            # catch it on their re-check tick
+            self.job_wakeup.notify(clerk for _, (clerk, _) in held)
         return released
 
     def get_snapshot_result(
@@ -524,6 +595,30 @@ class SdaServerService(SdaService):
     def get_clerking_job(self, caller, clerk):
         _acl_agent_is(caller, clerk)
         return self.server.poll_clerking_job(clerk)
+
+    def await_clerking_job(self, caller, clerk, wait_s: float = 0.0):
+        """Long-poll flavor of :meth:`get_clerking_job`: block up to
+        ``wait_s`` (clamped to the long-poll bound) for a job to appear,
+        parked on the server's job wakeup between store checks — the
+        in-process mirror of ``GET /v1/clerking-jobs?wait=S``. Returns
+        the job, or None when the wait expires empty. Not part of the
+        ``SdaService`` seam: callers probe for it with ``getattr`` and
+        fall back to plain polling (old peers, third-party seams)."""
+        _acl_agent_is(caller, clerk)
+        give_up = time.monotonic() + clamp_wait(wait_s)
+        tick = longpoll_tick()
+        while True:
+            sub = self.server.job_wakeup.subscribe(clerk)
+            try:
+                # poll AFTER subscribing so an enqueue between the two
+                # cannot be missed (it fires the live subscription)
+                job = self.server.poll_clerking_job(clerk)
+                remaining = give_up - time.monotonic()
+                if job is not None or remaining <= 0:
+                    return job
+                sub.wait(min(tick, remaining))
+            finally:
+                self.server.job_wakeup.unsubscribe(sub)
 
     def create_clerking_result(self, caller, result):
         # double-check the job really belongs to the caller — a spoofed

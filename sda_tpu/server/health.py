@@ -210,6 +210,11 @@ def sweep_worker_health(server, now: Optional[float] = None, *,
                 metrics.count("server.fleet.dead")
                 if recalled:
                     metrics.count("server.job.lease_recalled", recalled)
+                    # recalled jobs are poll-visible again RIGHT NOW: wake
+                    # every clerk parked on this worker's long-poll plane
+                    # (the recall doesn't know which clerks the dead node
+                    # served; waking all is cheap and correct)
+                    server.job_wakeup.notify_all()
                 obs.add_event("fleet.dead", node=node, recalled=recalled,
                               stale_s=round(stale, 3))
                 log.warning("fleet worker %s declared dead (%.2fs since "

@@ -243,6 +243,12 @@ def _snapshot_locked(server, snap: Snapshot) -> bool:
         # transaction on sqlite, one lock hold on memory/jsonfs, batched
         # round trips on mongo) instead of C commits of C full columns
         server.clerking_job_store.enqueue_clerking_jobs(jobs)
+        # long-poll push plane: stamp enqueue time (server.job.pickup
+        # histogram) and wake exactly the clerks that now have work, so a
+        # parked GET /v1/clerking-jobs?wait=S answers immediately instead
+        # of riding out its re-check tick (server/wakeup.py)
+        server.note_jobs_enqueued(job.id for job in jobs)
+        server.job_wakeup.notify(job.clerk for job in jobs)
     # lifecycle: jobs are durable, the committee can work — the round is
     # clerking and its deadline clock starts (lifecycle.py)
     lifecycle.note_clerking(server, snap.aggregation, snap.id)

@@ -21,12 +21,14 @@ from .metrics import (
     reset_histograms,
 )
 from .logsetup import configure_logging
+from .env import env_float
 
 __all__ = [
     "PhaseStat",
     "configure_logging",
     "count",
     "counter_report",
+    "env_float",
     "gauge_max",
     "gauge_report",
     "gauge_set",

@@ -168,6 +168,96 @@ def test_decode_rejects_wrong_resource_for_typed_decoder():
         bincodec.decode_clerking_job(bincodec.encode(_result()))
 
 
+# -- incremental (feed-based) decode ----------------------------------------
+
+_FEED_RESOURCES = [
+    _participation(),
+    _participation(recipient_encryption=False, clerks=1),
+    _participation(clerks=0),
+    _job(),
+    _result(),
+]
+
+
+@pytest.mark.parametrize("resource", _FEED_RESOURCES, ids=[
+    "participation", "participation-nomask", "participation-empty",
+    "job", "result"])
+@pytest.mark.parametrize("chunk", [1, 2, 3, 7, 16, 64, 10_000])
+def test_feed_decoder_matches_one_shot_at_every_chunk_size(resource, chunk):
+    """The streaming decoder is the same wire contract, delivered in
+    arbitrary network-chunk slices — byte-for-byte equal results."""
+    raw = bincodec.encode(resource)
+    decoder = bincodec.FeedDecoder()
+    for pos in range(0, len(raw), chunk):
+        decoder.feed(raw[pos:pos + chunk])
+    assert decoder.done
+    assert decoder.fed_bytes == len(raw)
+    assert decoder.finish() == resource
+    # the convenience iterator wrapper agrees
+    assert bincodec.decode_stream(
+        raw[pos:pos + chunk] for pos in range(0, len(raw), chunk)
+    ) == resource
+
+
+def test_feed_decoder_expect_tag_pins_resource_kind():
+    raw = bincodec.encode(_result())
+    decoder = bincodec.FeedDecoder(bincodec.TAG_PARTICIPATION)
+    with pytest.raises(ValueError):
+        decoder.feed(raw)
+
+
+def test_feed_decoder_truncation_and_trailing():
+    raw = bincodec.encode(_participation())
+    decoder = bincodec.FeedDecoder()
+    decoder.feed(raw[:-1])
+    assert not decoder.done
+    with pytest.raises(ValueError):
+        decoder.finish()  # truncated
+    decoder = bincodec.FeedDecoder()
+    with pytest.raises(ValueError):
+        decoder.feed(raw + b"\x00")  # trailing bytes
+    # trailing bytes in a LATER chunk are caught too
+    decoder = bincodec.FeedDecoder()
+    decoder.feed(raw)
+    with pytest.raises(ValueError):
+        decoder.feed(b"\x00")
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda raw: b"JSON" + raw[4:],
+    lambda raw: raw[:4] + b"\x63" + raw[5:],
+    lambda raw: raw[:5] + b"\x7f" + raw[6:],
+], ids=["magic", "version", "tag"])
+def test_feed_decoder_malformed_header_raises_midstream(mutate):
+    raw = mutate(bincodec.encode(_participation()))
+    decoder = bincodec.FeedDecoder()
+    with pytest.raises(ValueError):
+        for pos in range(0, len(raw), 3):
+            decoder.feed(raw[pos:pos + 3])
+
+
+def test_feed_decoder_releases_consumed_bytes():
+    """O(frame) memory: after feeding everything but the tail, the
+    internal buffer holds only the unparsed remainder — consumed field
+    bytes (the big ciphertexts) are not retained as raw input."""
+    big = Participation(
+        id=ParticipationId(_uuid(1)), participant=AgentId(_uuid(2)),
+        aggregation=AggregationId(_uuid(3)), recipient_encryption=None,
+        clerk_encryptions=[
+            (AgentId(_uuid(10 + i)),
+             Encryption("Sodium", Binary(bytes(200_000))))
+            for i in range(8)
+        ],
+    )
+    raw = bincodec.encode(big)
+    decoder = bincodec.FeedDecoder()
+    for pos in range(0, len(raw), 65536):
+        decoder.feed(raw[pos:pos + 65536])
+        # transient buffer never holds more than one unparsed frame tail
+        assert len(decoder._buf) < 256_000
+    assert decoder.finish() == big
+
+
 # -- mixed-version negotiation over the real HTTP stack ----------------------
 
 sodium_available = pytest.importorskip(

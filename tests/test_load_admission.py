@@ -162,22 +162,23 @@ def test_inflight_shed_does_not_burn_the_rate_token():
 
 
 def test_route_templates_cover_every_dispatched_route():
-    """Drift tripwire: _ROUTE_TEMPLATES is maintained next to the
-    dispatch table — a route added to _dispatch without a template would
-    silently fold its latency into the 'unmatched' bucket."""
+    """Drift tripwire: ROUTE_TEMPLATES is maintained next to the shared
+    dispatch table (http/base.py, serving BOTH planes) — a route added to
+    dispatch without a template would silently fold its latency into the
+    'unmatched' bucket."""
     import inspect
     import re as _re
 
-    from sda_tpu.http import server as server_mod
+    from sda_tpu.http import base as base_mod
 
-    src = inspect.getsource(server_mod._Handler._dispatch)
+    src = inspect.getsource(base_mod._dispatch_inner)
     routes = set(_re.findall(r'path == "([^"]+)"', src))
     routes |= {
         pattern.replace("({_ID})", "{id}")
         for pattern in _re.findall(r'm\(rf"([^"]+)"\)', src)
     }
     assert len(routes) >= 15, "dispatch-table parse went stale"
-    missing = routes - server_mod._ROUTE_TEMPLATES
+    missing = routes - base_mod.ROUTE_TEMPLATES
     assert not missing, f"routes without a latency template: {missing}"
 
 
