@@ -232,6 +232,43 @@ PY
 python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$STORM_RECORD"
 rm -f "$STORM_RECORD"
 
+echo "== devscale drill (fixed seed: sharded tile schedule, interpret-mode Pallas external randomness, shrunk dim; bit-exact vs oracle, zero retraces, HBM under watermark)"
+DEVSCALE_RECORD=$(mktemp /tmp/sda-devscale-XXXX.json)
+DEVSCALE=$(env JAX_PLATFORMS=cpu SDA_SIM_PLATFORM=cpu python -m sda_tpu.cli.sim --devscale \
+  --devscale-dim 25000 --devscale-participants 8 --devscale-shards 4x2 \
+  --devscale-pallas --devscale-rounds 3 --devscale-seed 20260804)
+DEVSCALE="$DEVSCALE" DEVSCALE_RECORD="$DEVSCALE_RECORD" python - <<'PY'
+import json, os
+record = json.loads(os.environ["DEVSCALE"].strip().splitlines()[-1])
+# the model-scale schedule at a CI-sized dim: the sharded+streamed round
+# under interpret-mode Pallas (external randomness) must reveal the
+# oracle lane's bytes exactly, reuse ONE compiled shape per stage with
+# zero retraces, keep its HBM promise, and the clerk-pipeline-fed
+# device-tile sink must reproduce the device-generated lane bit-for-bit
+assert record["ok"] is True, record
+assert record["exact"] is True, record["oracle"]
+assert record["pallas"] is True, record
+assert record["retraces"] == 0 and record["warm_program_reused"], record
+assert all(v == 1 for v in record["compiled_shapes"].values()), record["compiled_shapes"]
+assert record["clerk_fed"]["exact"] is True, record["clerk_fed"]
+assert record["clerk_fed"]["sink_misses"] == 0, record["clerk_fed"]
+assert record["scan_lane"]["exact"] is True, record["scan_lane"]
+assert record["hbm"]["within_watermark"] is True, record["hbm"]
+assert record["tile_rule"] == "hbm_watermark", record
+with open(os.environ["DEVSCALE_RECORD"], "w") as f:
+    json.dump(record, f)
+print(f"devscale drill OK: dim {record['dim']} over {record['p_shards']}x"
+      f"{record['d_shards']} mesh, tile {record['dim_tile']} "
+      f"(hbm ratio {record['hbm_watermark_ratio']}), "
+      f"{record['value']} el/s, retraces {record['retraces']}, "
+      f"sink hits {record['clerk_fed']['sink_hits']}")
+PY
+# the devscale record must parse and gate advisory (its comparability
+# tags — dim/p_shards/d_shards/pallas — seed a fresh lineage vs the
+# committed dim-1e8 record)
+python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$DEVSCALE_RECORD"
+rm -f "$DEVSCALE_RECORD"
+
 echo "== tree drill (fixed seed: 2-level tree over sqlite+HTTP, ~10% leaf dropout, bit-exact vs flat reference; simulated 1e5-participant record)"
 TREE=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --tree --participants 24 --dim 4 \
   --tree-group-size 6 --tree-seed 20260803 --tree-dropout 0.1 --tree-sim 100000)

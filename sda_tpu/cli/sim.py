@@ -346,6 +346,44 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--connstorm-threaded", action="store_true",
                         help="storm the thread-per-connection plane "
                              "instead (comparison runs) (--connstorm)")
+    parser.add_argument("--devscale", action="store_true",
+                        help="model-scale device-plane bench: the full "
+                             "round at FL-model dimension, sharded over "
+                             "the (p, d) mesh, streamed through HBM at "
+                             "the watermark-derived tile width, with the "
+                             "clerk-fed device-tile sink exercised "
+                             "(loadgen/devscale.py); one BENCH-style "
+                             "JSON line (docs/performance.md)")
+    parser.add_argument("--devscale-dim", type=int, metavar="D",
+                        default=100_000_000,
+                        help="round dimension (--devscale; default the "
+                             "1e8 model-scale rung)")
+    parser.add_argument("--devscale-family",
+                        choices=["mobilelite", "lora", "devscale"],
+                        default=None,
+                        help="size the dimension from a flagship FL "
+                             "family instead of --devscale-dim "
+                             "(sda_tpu/fl/flagship.py)")
+    parser.add_argument("--devscale-participants", type=int, default=8,
+                        help="participant rows (--devscale)")
+    parser.add_argument("--devscale-shards", metavar="PxD", default=None,
+                        help="mesh shape, e.g. 4x2 (--devscale; default "
+                             "from the device count and committee)")
+    parser.add_argument("--devscale-tile", type=int, default=None,
+                        help="explicit dim-tile width (--devscale; "
+                             "default derives from the HBM watermark)")
+    parser.add_argument("--devscale-pallas", action="store_true",
+                        help="fuse the per-tile mask+share+combine into "
+                             "the Pallas kernel on the sharded path "
+                             "(--devscale; interpret-mode with external "
+                             "randomness on CPU)")
+    parser.add_argument("--devscale-rounds", type=int, default=3,
+                        help="rounds (1 warm + N-1 timed) (--devscale)")
+    parser.add_argument("--devscale-mask",
+                        choices=["none", "full", "chacha"], default="full",
+                        help="masking scheme (--devscale)")
+    parser.add_argument("--devscale-seed", type=int, default=0,
+                        help="input/randomness seed (--devscale)")
     parser.add_argument("--chaos", action="store_true",
                         help="robustness profile: run a full federated "
                              "round over real HTTP with deterministic "
@@ -856,6 +894,61 @@ def _run_connstorm(args) -> int:
     return 0 if record["ok"] else 1
 
 
+def _run_devscale(args) -> int:
+    """--devscale: the model-scale device-plane bench
+    (sda_tpu/loadgen/devscale.py) — the sharded+streamed+fused round at
+    FL-model dimension, one BENCH-style JSON line whose headline is
+    elements/sec through the complete round."""
+    import os
+
+    shards = None
+    if args.devscale_shards:
+        try:
+            p_s, d_s = (int(v) for v in args.devscale_shards.split("x"))
+            if p_s <= 0 or d_s <= 0:
+                raise ValueError("shard counts must be positive")
+        except ValueError:
+            print(f"error: --devscale-shards expects PxD with positive "
+                  f"counts (e.g. 4x2), got {args.devscale_shards!r}",
+                  file=sys.stderr)
+            return 1
+        shards = (p_s, d_s)
+        # the mesh needs p*d devices; on the CPU backend force enough
+        # virtual devices BEFORE any jax import initializes the backend
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={p_s * d_s}"
+            ).strip()
+
+    from ..utils.backend import select_platform, use_platform
+
+    platform = select_platform("SDA_SIM_PLATFORM")
+    use_platform(platform)
+
+    from ..loadgen import DevScaleProfile, run_devscale
+
+    record = run_devscale(DevScaleProfile(
+        dim=args.devscale_dim,
+        family=args.devscale_family,
+        participants=args.devscale_participants,
+        participants_chunk=min(args.devscale_participants, 8),
+        p_shards=shards[0] if shards else None,
+        d_shards=shards[1] if shards else None,
+        dim_tile=args.devscale_tile,
+        pallas=args.devscale_pallas,
+        # the TPU PRNG primitive is hardware-only: CPU runs interpret
+        # the kernel with injected external randomness
+        pallas_interpret=bool(args.devscale_pallas) and platform == "cpu",
+        rounds=args.devscale_rounds,
+        mask=args.devscale_mask,
+        seed=args.devscale_seed,
+    ))
+    _export_trace(args, record)
+    print(json.dumps(record))
+    return 0 if record["ok"] else 1
+
+
 def _run_chaos(args) -> int:
     """--chaos: the robustness drill — a full federated round over real
     HTTP under deterministic fault injection (sda_tpu/chaos/drill.py),
@@ -945,6 +1038,8 @@ def main(argv=None) -> int:
         return _run_pickup(args)
     if args.connstorm:
         return _run_connstorm(args)
+    if args.devscale:
+        return _run_devscale(args)
     if args.fl:
         return _run_fl(args)
     if args.soak:

@@ -661,6 +661,22 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
             own_key_id, aggregation.committee_encryption_scheme
         )
         combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
+        # SDA_CLERK_DEVICE_TILES=1: fold decrypted bundles into a
+        # DEVICE-resident tiled accumulator (mesh/devscale.py) instead of
+        # host numpy — each [B, tile] tile lands on device while the
+        # previous tile folds, and the decrypt pipeline below overlaps
+        # both. Bit-exact with mod_combine (tests/test_devscale.py); any
+        # surprise building the device path falls back to the host fold.
+        dev_combiner = None
+        if os.environ.get("SDA_CLERK_DEVICE_TILES") == "1":
+            try:
+                from ..mesh.devscale import DeviceTileCombiner
+
+                dev_combiner = DeviceTileCombiner(combiner.modulus)
+            except Exception:
+                log.warning("device-tile clerk combine unavailable; "
+                            "falling back to the host fold", exc_info=True)
+                metrics.count("clerk.device_tiles.unavailable")
 
         # the recipient key is only needed AFTER the last combine: fetch
         # and signature-verify it on the pool while the pipeline decrypts
@@ -690,9 +706,20 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
                 if share_vectors is None:
                     break
                 with timed_phase("clerk.combine"):
-                    partial = combiner.combine(share_vectors)
-                    combined = (partial if combined is None
-                                else combiner.combine([combined, partial]))
+                    if dev_combiner is not None:
+                        dev_combiner.fold(
+                            np.asarray(share_vectors, dtype=np.int64))
+                        metrics.count("clerk.device_tiles.bundle")
+                    else:
+                        partial = combiner.combine(share_vectors)
+                        combined = (partial if combined is None
+                                    else combiner.combine([combined, partial]))
+        if dev_combiner is not None and dev_combiner.folded:
+            # fold() only dispatches device work; the blocking fetch here
+            # is where the combine cost is actually paid in device-tile
+            # mode, so it must land in the same phase
+            with timed_phase("clerk.combine"):
+                combined = dev_combiner.result()
         if combined is None:  # empty job: keep the scalar path's shape
             combined = combiner.combine([])
 

@@ -25,10 +25,14 @@ Entry points:
 
 from .data import load_mnist_idx, shard_dataset, synthetic_classification
 from .dp import gaussian_accounting
+from .flagship import FLAGSHIP_FAMILIES, flagship_dim, flagship_dims
 from .scenario import FLProfile, run_fl
 
 __all__ = [
+    "FLAGSHIP_FAMILIES",
     "FLProfile",
+    "flagship_dim",
+    "flagship_dims",
     "run_fl",
     "gaussian_accounting",
     "load_mnist_idx",

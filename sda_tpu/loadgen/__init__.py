@@ -9,6 +9,7 @@ server's admission control. Entry points: ``sda-sim --load`` (CLI) and
 """
 
 from .connstorm import ConnstormProfile, run_connstorm
+from .devscale import DevScaleProfile, run_devscale
 from .driver import (
     LoadProfile,
     latency_report_ms,
@@ -22,6 +23,7 @@ from .pickup import PickupProfile, run_pickup_bench
 # eagerly: importing a ``-m`` target from its package __init__ trips
 # runpy's double-import warning. ``from sda_tpu.loadgen.inputbench import
 # run_input_bench`` for programmatic use.
-__all__ = ["ConnstormProfile", "LoadProfile", "PickupProfile",
-           "latency_report_ms", "run_connstorm", "run_fleet_scaling",
-           "run_load", "run_pickup_bench"]
+__all__ = ["ConnstormProfile", "DevScaleProfile", "LoadProfile",
+           "PickupProfile", "latency_report_ms", "run_connstorm",
+           "run_devscale", "run_fleet_scaling", "run_load",
+           "run_pickup_bench"]

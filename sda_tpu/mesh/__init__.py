@@ -1,6 +1,12 @@
 """TPU-native simulated-pod execution over a device mesh."""
 
 from . import multihost
+from .devscale import (
+    DeviceTileCombiner,
+    DeviceTileSink,
+    ModelScaleRound,
+    watermark_dim_tile,
+)
 from .simpod import (
     SimulatedPod,
     default_mesh_shape,

@@ -625,6 +625,7 @@ class StreamedPod:
         pallas_interpret: bool = False,
         pallas_external_bits_fn=None,
         surviving_clerks=None,
+        uniform_tail: bool = False,
     ):
         from .simpod import SimulatedPod, default_mesh_shape, make_mesh
 
@@ -650,6 +651,15 @@ class StreamedPod:
         # round the tile sizes up to the mesh grain
         self.participants_chunk = -(-int(participants_chunk) // p_shards) * p_shards
         self.dim_chunk = -(-int(dim_chunk) // grain) * grain
+        # uniform_tail pads the LAST dim tile to the full dim_chunk width
+        # (zero columns aggregate as zero; per-tile masks cancel), so every
+        # tile shares ONE compiled step/finale shape — and a DIFFERENT tile
+        # count (a different model dim at the same tile width) reuses the
+        # exact same compiled per-tile program. The model-scale driver
+        # (mesh/devscale.py) runs with this on; exactness pinned in
+        # tests/test_devscale.py. The participant axis is always uniform
+        # here (make_block pads every block to participants_chunk rows).
+        self.uniform_tail = bool(uniform_tail)
         self.surviving_clerks = _normalize_survivors(s, surviving_clerks)
         self._M_host, self._L_host = _build_matrices(s, self.surviving_clerks)
         self._field = FieldOps.create(self.modulus, cross_terms=p_shards)
@@ -801,11 +811,17 @@ class StreamedPod:
         )
 
     def _checkpoint_fingerprint(self, participants, dimension, key):
+        # tail padding changes accumulator shapes mid-round, so a snapshot
+        # must never cross the uniform_tail setting (included only when
+        # set: existing False-mode snapshots keep their fingerprint)
+        extra = {"mesh": list(self.mesh.devices.shape)}
+        if self.uniform_tail:
+            extra["uniform_tail"] = True
         return _round_fingerprint(
             self.scheme, self.masking, participants, dimension,
             self.participants_chunk, self.dim_chunk, self.pallas_active,
             self.surviving_clerks, key,
-            extra={"mesh": list(self.mesh.devices.shape)},
+            extra=extra,
         )
 
     def drive_tiles(

@@ -115,7 +115,8 @@ class LoRAMLP(nn.Module):
     on every participant; only the adapter params (A, B per layer) are
     trained and securely aggregated. ``lora_adapter_params`` extracts that
     trainable sub-tree; at (features=4096, layers=4, rank=400) the adapter
-    vector is ~13.1M params — the lora-13m benchmark workload.
+    vector is 11,782,400 params (~11.8M; `fl/flagship.py` pins the exact
+    count) — the lora-13m benchmark workload.
     """
 
     features: int = 4096

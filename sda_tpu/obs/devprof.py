@@ -50,9 +50,12 @@ from . import trace as _trace
 __all__ = [
     "CHIP_PEAKS",
     "FnProfile",
+    "HBM_WATERMARK_DEFAULTS",
     "compile_totals",
     "cost_analysis_enabled",
     "enable_cost_analysis",
+    "hbm_peak_recorded",
+    "hbm_watermark",
     "install_monitoring",
     "instrument",
     "profile",
@@ -60,6 +63,7 @@ __all__ = [
     "reset",
     "roofline",
     "roofline_block",
+    "watermark_report",
 ]
 
 #: Chip peaks for the roofline model, per platform family. The tpu row is
@@ -80,6 +84,23 @@ CHIP_PEAKS = {
         "source": "nominal CPU placeholder — utilization advisory only",
     },
 }
+
+#: Per-device HBM budgets (bytes) backing the watermark contract
+#: (docs/performance.md "Model scale"): the model-scale drivers derive
+#: their dim-tile width from this budget instead of a magic chunk
+#: constant, and every devscale record reports ``hbm_peak_bytes /
+#: watermark``. The tpu row is the v5e 16 GiB HBM; the cpu row is a
+#: deliberately small host-scaled stand-in so CPU CI exercises the SAME
+#: tiling arithmetic a real chip would (a host-RAM-sized budget would
+#: let CI pick untiled widths the chip could never hold).
+HBM_WATERMARK_DEFAULTS = {
+    "tpu": 16 * (1 << 30),
+    "cpu": 1 << 30,
+}
+
+#: fraction of the device budget the round may plan against — headroom
+#: for the XLA allocator, collective scratch, and the framework itself
+DEFAULT_WATERMARK_FRACTION = 0.8
 
 _lock = threading.Lock()
 _profiles: "Dict[str, FnProfile]" = {}
@@ -547,6 +568,89 @@ def roofline(seconds: Optional[float] = None, names=None,
                            platform=platform, hbm_peak_bytes=hbm_peak)
     block["basis"] = basis
     block["phases"] = phases
+    return block
+
+
+# -- HBM watermark ------------------------------------------------------------
+
+def hbm_watermark(platform: Optional[str] = None) -> int:
+    """The per-device HBM budget (bytes) model-scale rounds must plan
+    under — THE number the devscale tile-width rule divides by.
+
+    Resolution order:
+
+    1. ``SDA_HBM_WATERMARK`` — explicit budget in bytes (already
+       fraction-adjusted: what the operator says is what the planner
+       gets).
+    2. The live device's ``memory_stats()["bytes_limit"]`` when the
+       backend reports one (real TPU), times the headroom fraction.
+    3. The platform default from :data:`HBM_WATERMARK_DEFAULTS` times
+       the fraction (``SDA_HBM_WATERMARK_FRACTION``, default 0.8).
+
+    The CPU default is deliberately chip-sized, not host-sized — see
+    :data:`HBM_WATERMARK_DEFAULTS`.
+    """
+    raw = os.environ.get("SDA_HBM_WATERMARK")
+    if raw:
+        try:
+            value = int(float(raw))
+            if value > 0:
+                return value
+        except ValueError:
+            pass
+    frac = DEFAULT_WATERMARK_FRACTION
+    fraw = os.environ.get("SDA_HBM_WATERMARK_FRACTION")
+    if fraw:
+        try:
+            frac = min(1.0, max(0.05, float(fraw)))
+        except ValueError:
+            pass
+    family, _ = _peaks(platform)
+    if family not in ("cpu",):
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            limit = int(stats.get("bytes_limit") or 0)
+            if limit > 0:
+                return int(limit * frac)
+        except Exception:
+            pass  # backend without memory_stats: fall to the default
+    budget = HBM_WATERMARK_DEFAULTS.get(family,
+                                        HBM_WATERMARK_DEFAULTS["cpu"])
+    return int(budget * frac)
+
+
+def hbm_peak_recorded(names=None) -> int:
+    """Max ``hbm_peak_bytes`` across the recorded cost entries (0 when
+    cost analysis was off — the caller should say so, not guess)."""
+    with _lock:
+        profs = [p for n, p in _profiles.items()
+                 if names is None or n in names]
+    peak = 0
+    for prof in profs:
+        peak = max(peak, prof.totals()["hbm_peak_bytes"])
+    return peak
+
+
+def watermark_report(peak_bytes: Optional[int] = None,
+                     platform: Optional[str] = None, names=None) -> dict:
+    """The ``hbm`` advisory block devscale records carry: measured peak,
+    the watermark it was planned against, and their ratio (< 1.0 means
+    the round kept its HBM promise)."""
+    watermark = hbm_watermark(platform)
+    peak = int(peak_bytes if peak_bytes is not None
+               else hbm_peak_recorded(names))
+    block = {
+        "hbm_peak_bytes": peak,
+        "watermark_bytes": watermark,
+        "within_watermark": peak <= watermark,
+    }
+    if watermark:
+        block["hbm_watermark_ratio"] = round(peak / watermark, 4)
+    if peak == 0:
+        block["note"] = ("no cost entries recorded — enable_cost_analysis"
+                         " was off or no instrumented call compiled")
     return block
 
 

@@ -54,6 +54,13 @@ METRICS = (
     ("round_seconds_marginal", "lower", True),
     ("compile_seconds", "lower", False),
     ("scaling_efficiency", "higher", False),
+    # model-scale device records (loadgen/devscale.py): utilization is
+    # chip-peak-relative (advisory — CPU peaks are nominal placeholders)
+    # and the watermark ratio is a promise-keeping advisory (peak HBM
+    # over the budget the tile width was derived from; > 1.0 means the
+    # round broke its HBM contract, creeping UP means headroom eroding)
+    ("roofline_utilization", "higher", False),
+    ("hbm_watermark_ratio", "lower", False),
 )
 
 DEFAULT_WINDOW = 4
@@ -125,11 +132,18 @@ def _comparable(newest: dict, rec: dict) -> bool:
     # loadgen number must never gate against JSON-wire history, and a
     # 4-worker fleet RPS must never gate against single-server history
     # (the codec / worker count IS the variable under test); records
-    # without the tags compare as before
+    # without the tags compare as before. The model-scale device records
+    # additionally key on (dim, p_shards, d_shards, pallas): a dim-1e8
+    # sharded+streamed number must never gate against single-chip
+    # history, a different mesh topology, or the other kernel lane.
     return (rec.get("platform") == newest.get("platform")
             and rec.get("metric") == newest.get("metric")
             and rec.get("codec") == newest.get("codec")
-            and rec.get("fleet_nodes") == newest.get("fleet_nodes"))
+            and rec.get("fleet_nodes") == newest.get("fleet_nodes")
+            and rec.get("dim") == newest.get("dim")
+            and rec.get("p_shards") == newest.get("p_shards")
+            and rec.get("d_shards") == newest.get("d_shards")
+            and rec.get("pallas") == newest.get("pallas"))
 
 
 def chain_rel_uncertainty(rec: dict) -> float:

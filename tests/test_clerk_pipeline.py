@@ -206,6 +206,28 @@ def test_cache_disabled_round_still_exact(monkeypatch):
     _run_round(seed=7)
 
 
+@pytestmark_sodium
+@pytest.mark.parametrize("batch", ["1", "3"])
+def test_device_tile_clerk_combine_bit_exact(monkeypatch, batch):
+    # SDA_CLERK_DEVICE_TILES=1: decrypted bundles fold into the device-
+    # resident tiled accumulator (mesh/devscale.py DeviceTileCombiner)
+    # instead of host numpy — the revealed bytes must not change
+    # (_run_round asserts against the plain sum internally)
+    from sda_tpu.utils import metrics as _metrics
+
+    monkeypatch.setenv("SDA_CLERK_DEVICE_TILES", "1")
+    monkeypatch.setenv("SDA_CLERK_BATCH", batch)
+    monkeypatch.setenv("SDA_CRYPTO_WORKERS", "2")
+    crypto_batch.reset()
+    try:
+        _run_round(seed=20260804)
+        counters = _metrics.counter_report("clerk.device_tiles")
+        assert counters.get("clerk.device_tiles.bundle", 0) > 0, \
+            "device-tile path never engaged"
+    finally:
+        crypto_batch.reset()
+
+
 # -- document cache ----------------------------------------------------------
 
 class _CountingService:

@@ -103,6 +103,45 @@ def test_streaming_at_most_two_compiled_shapes_per_axis():
     assert len({s[-1] for s in finales}) <= 2
 
 
+def test_model_scale_rounds_one_shape_per_stage_zero_retraces():
+    """The sharded+streamed model-scale path (mesh/devscale.py drives
+    StreamedPod with uniform tails): repeated same-shape rounds must
+    register at most ONE compiled shape per stage, and a TILE-COUNT
+    change (a different dim at the same tile width) must reuse the
+    per-tile step program — only the per-dim-size finale may add a
+    shape."""
+    import jax
+
+    from sda_tpu.mesh import StreamedPod, make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    scheme, p = _scheme()
+    pod = StreamedPod(scheme, FullMasking(p), mesh=make_mesh(4, 2),
+                      participants_chunk=8, dim_chunk=96, uniform_tail=True)
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, 1 << 10, size=(16, 250), dtype=np.int64)
+    for _ in range(3):  # 3 rounds, 3 tiles each: same shapes throughout
+        out = pod.aggregate(x, key=jax.random.PRNGKey(1))
+    assert (np.asarray(out) == x.sum(axis=0) % p).all()
+    step = devprof.profile("stream.pod.step")
+    finale = devprof.profile("stream.pod.finale")
+    assert len(step.shapes) == 1, step.block_shapes()
+    assert len(finale.shapes) == 1
+    assert step.retraces == 0 and finale.retraces == 0
+    step_compiles = step.compiles
+    # 5 tiles instead of 3: the per-tile program must NOT retrace
+    x2 = rng.integers(0, 1 << 10, size=(16, 460), dtype=np.int64)
+    out2 = pod.aggregate(x2, key=jax.random.PRNGKey(2))
+    assert (np.asarray(out2) == x2.sum(axis=0) % p).all()
+    step = devprof.profile("stream.pod.step")
+    assert len(step.shapes) == 1, \
+        f"tile-count change retraced the per-tile program: " \
+        f"{step.block_shapes()}"
+    assert step.compiles == step_compiles and step.retraces == 0
+    assert metrics.counter_report("xla.compile.retrace") == {}
+
+
 def test_streaming_uniform_tail_single_step_shape():
     scheme, p = _scheme()
     agg = StreamingAggregator(scheme, FullMasking(p), participants_chunk=4,

@@ -1,0 +1,133 @@
+"""Dim-tile schedule tail handling: property coverage of scan_dim_tiles.
+
+The model-scale plane (mesh/devscale.py) leans on the tiled schedule at
+dimensions that are never a multiple of the tile grain, so the tail
+arithmetic is load-bearing: a dim off the grain must produce BIT-EXACT
+results vs the untiled reference for the full (sharing x masking)
+lattice, including the exactly-one-tile and one-element-tail edges.
+``tile_plan`` is the shared arithmetic (the in-program scan and the
+host-driven model-scale loop both slice with it), pinned directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sda_tpu.fields import numtheory
+from sda_tpu.fields.dimtile import TilePlan, scan_dim_tiles, tile_plan
+from sda_tpu.mesh import single_chip_round
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+)
+
+
+def _packed():
+    t, p, w2, w3 = numtheory.generate_packed_params(3, 8, 28)
+    return PackedShamirSharing(3, 8, t, p, w2, w3)
+
+
+def _additive():
+    return AdditiveSharing(share_count=5, modulus=(1 << 29) - 679)
+
+
+# -- tile_plan: the shared schedule arithmetic --------------------------------
+
+def test_tile_plan_rounds_width_to_grain():
+    assert tile_plan(200, 24, 90) == TilePlan(96, 3, 88)
+    assert tile_plan(96, 24, 96) == TilePlan(96, 1, 0)
+
+
+def test_tile_plan_narrow_dim_shrinks_to_one_grain_rounded_tile():
+    # a wide tile knob must not inflate small shapes
+    plan = tile_plan(50, 24, 4096)
+    assert plan == TilePlan(72, 1, 22)
+    assert plan.padded_dim == 72
+
+
+def test_tile_plan_one_element_tail():
+    # dim = one full tile + 1 element: the tail tile is all padding but 1
+    plan = tile_plan(97, 24, 96)
+    assert plan.width == 96 and plan.n_tiles == 2 and plan.pad == 95
+
+
+def test_tile_plan_rejects_bad_knobs():
+    with pytest.raises(ValueError):
+        tile_plan(10, 8, 0)
+    with pytest.raises(ValueError):
+        tile_plan(10, 0, 8)
+
+
+def test_tile_plan_covers_every_dim_property():
+    # property sweep: for any (dim, grain, tile) the plan tiles cover the
+    # dim exactly once with grain-aligned width
+    rng = np.random.default_rng(20260804)
+    for _ in range(200):
+        grain = int(rng.integers(1, 30))
+        dim = int(rng.integers(1, 2000))
+        tile = int(rng.integers(1, 500))
+        plan = tile_plan(dim, grain, tile)
+        assert plan.width % grain == 0
+        assert plan.n_tiles * plan.width == dim + plan.pad
+        assert 0 <= plan.pad < plan.width
+
+
+# -- scan_dim_tiles tails: the four (sharing x masking) configs ---------------
+
+def _round_pair(scheme, masking, dim_tile):
+    tiled = jax.jit(single_chip_round(scheme, masking, dim_tile=dim_tile))
+    untiled = jax.jit(single_chip_round(scheme, masking))
+    return tiled, untiled
+
+
+CONFIGS = [
+    ("packed-none", _packed, NoMasking),
+    ("packed-full", _packed, "full"),
+    ("additive-none", _additive, NoMasking),
+    ("additive-full", _additive, "full"),
+]
+
+
+@pytest.mark.parametrize("name,make_scheme,mask_kind", CONFIGS,
+                         ids=[c[0] for c in CONFIGS])
+def test_tail_dims_bit_exact_vs_untiled_reference(name, make_scheme,
+                                                  mask_kind):
+    """Dims OFF the tile grain: the tiled schedule must reveal the same
+    bytes as the untiled program (both equal the plain column sum — the
+    aggregate is deterministic, so this IS bit-exactness)."""
+    scheme = make_scheme()
+    m = getattr(scheme, "prime_modulus", None) or scheme.modulus
+    masking = NoMasking() if mask_kind is NoMasking else FullMasking(m)
+    T = 96  # grain 24 (packed k=3 x 8) / 8 (additive): 96 fits both
+    tiled, untiled = _round_pair(scheme, masking, T)
+    rng = np.random.default_rng(hash(name) % (1 << 31))
+    # the edges the satellite names, plus a seeded off-grain dim:
+    #   T      — exactly one tile (runs the scan, not the direct path)
+    #   T + 1  — one-element tail (tail tile all padding but one column)
+    dims = [T, T + 1, 2 * T + 7, int(rng.integers(T + 2, 4 * T))]
+    for i, dim in enumerate(dims):
+        inputs = rng.integers(0, 1 << 20, size=(5, dim), dtype=np.int64)
+        key = jax.random.PRNGKey(dim)
+        out_t = np.asarray(tiled(jnp.asarray(inputs), key))
+        expected = inputs.sum(axis=0) % m
+        np.testing.assert_array_equal(out_t, expected,
+                                      err_msg=f"{name} tiled dim={dim}")
+        if i < 2:  # anchor the untiled reference at the edge dims (each
+            # extra dim costs a full-width compile; the aggregate is the
+            # deterministic column sum either way)
+            out_u = np.asarray(untiled(jnp.asarray(inputs), key))
+            np.testing.assert_array_equal(out_u, expected,
+                                          err_msg=f"{name} untiled "
+                                                  f"dim={dim}")
+
+
+def test_one_element_dim_runs_direct_path():
+    # dim=1 is narrower than any tile: the direct (no scan) path
+    scheme = _packed()
+    fn = jax.jit(single_chip_round(scheme, FullMasking(scheme.prime_modulus),
+                                   dim_tile=96))
+    out = np.asarray(fn(jnp.asarray([[7], [11]]), jax.random.PRNGKey(0)))
+    assert out.tolist() == [18]

@@ -135,6 +135,65 @@ def test_codec_tagged_records_gate_among_themselves(tmp_path):
     assert "value" in result["regressions"]
 
 
+def test_devscale_tags_open_their_own_trajectory(tmp_path):
+    # model-scale device records key comparability on (dim, p_shards,
+    # d_shards, pallas): a dim-1e8 sharded record must never gate
+    # against single-chip history — and WITHIN the devscale lineage, a
+    # different mesh topology or kernel lane opens a fresh window too
+    base = {"metric": "model-scale device round elements/sec",
+            "platform": "cpu", "dim": 100_000_000, "p_shards": 4,
+            "d_shards": 2, "pallas": False}
+    paths = []
+    for n, value in enumerate([5_000_000, 5_100_000, 4_900_000], start=1):
+        rec = dict(base, value=value)
+        path = tmp_path / f"BENCH_d{n:02d}.json"
+        path.write_text(json.dumps(rec))
+        paths.append(str(path))
+    # same tags: a 2x slowdown gates
+    slow = tmp_path / "BENCH_d09.json"
+    slow.write_text(json.dumps(dict(base, value=2_400_000)))
+    result = regress.check(regress.load_records(paths + [str(slow)]))
+    assert result["checked"] and "value" in result["regressions"]
+    # a different topology must NOT gate against that history
+    other = tmp_path / "BENCH_d10.json"
+    other.write_text(json.dumps(dict(base, p_shards=8, d_shards=1,
+                                     value=2_400_000)))
+    result = regress.check(regress.load_records(paths + [str(other)]))
+    assert not result["checked"]
+    # ... nor the other kernel lane, nor a different dim
+    lane = tmp_path / "BENCH_d11.json"
+    lane.write_text(json.dumps(dict(base, pallas=True, value=2_400_000)))
+    assert not regress.check(regress.load_records(paths + [str(lane)]))[
+        "checked"]
+    dim = tmp_path / "BENCH_d12.json"
+    dim.write_text(json.dumps(dict(base, dim=3_731_890, value=2_400_000)))
+    assert not regress.check(regress.load_records(paths + [str(dim)]))[
+        "checked"]
+
+
+def test_devscale_advisory_metrics_reported_not_gated(tmp_path):
+    # roofline utilization and the hbm watermark ratio ride the record as
+    # advisory rows: a worse newest value is REPORTED but never exits 1
+    base = {"metric": "model-scale device round elements/sec",
+            "platform": "cpu", "dim": 1000, "p_shards": 4, "d_shards": 2,
+            "pallas": False, "value": 5_000_000,
+            "roofline_utilization": 0.5, "hbm_watermark_ratio": 0.4}
+    paths = []
+    for n in range(3):
+        path = tmp_path / f"BENCH_a{n:02d}.json"
+        path.write_text(json.dumps(base))
+        paths.append(str(path))
+    worse = tmp_path / "BENCH_a09.json"
+    worse.write_text(json.dumps(dict(base, roofline_utilization=0.1,
+                                     hbm_watermark_ratio=0.99)))
+    result = regress.check(regress.load_records(paths + [str(worse)]))
+    rows = {r["metric"]: r for r in result["rows"]}
+    assert not rows["roofline_utilization"]["gates"]
+    assert not rows["hbm_watermark_ratio"]["gates"]
+    assert result["regressions"] == []
+    assert regress.main(paths + [str(worse)]) == 0
+
+
 def test_record_carried_direction_lower(tmp_path):
     # the FL suite's rounds-to-target record tags itself direction=lower:
     # MORE rounds is the regression, fewer is an improvement — the gate
