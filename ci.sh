@@ -471,6 +471,98 @@ rm -f "$FL_RECORD"
 # one vectorized normalization at model dim instead of 1e5 int() calls
 python -m sda_tpu.loadgen.inputbench --dim 100000
 
+echo "== poisoning drill (fixed seed: boost:-8 at r=0.4 — undefended degrades, norm-clip defense recovers, BOTH bit-exact with clerk-side detections; tree-mode trimmed mean)"
+# A/B/C at one seed: the same seeded attacker plan (chaos/poison.py)
+# corrupts the same devices in all poisoned legs, so the accuracy
+# deltas are attributable to the defense, not the draw
+POISON_ARGS=(--fl --participants 5 --fl-rounds 2 --fl-seed 3)
+CLEAN=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim "${POISON_ARGS[@]}")
+UNDEF=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim "${POISON_ARGS[@]}" \
+  --poison 0.4)
+DEFEND=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim "${POISON_ARGS[@]}" \
+  --poison 0.4 --fl-norm-clip 0.5)
+# tree-mode leg: signflip attackers inside leaf groups, robust
+# (trimmed-mean) recipient aggregation over unmasked leaf subtotals
+TREE=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --fl --participants 9 \
+  --fl-rounds 2 --fl-seed 5 --fl-tree-group 3 \
+  --poison 0.25 --poison-kind signflip --fl-tree-robust)
+POISON_RECORD=$(mktemp /tmp/sda-poison-XXXX.json)
+CLEAN="$CLEAN" UNDEF="$UNDEF" DEFEND="$DEFEND" TREE="$TREE" \
+  POISON_RECORD="$POISON_RECORD" python - <<'PY'
+import json, os
+last = lambda k: json.loads(os.environ[k].strip().splitlines()[-1])
+clean, undef, defend, tree = map(last, ("CLEAN", "UNDEF", "DEFEND", "TREE"))
+# bit-exactness is unconditional: poisoning corrupts INPUTS, never the
+# protocol — every revealed round still equals the plaintext quantized
+# sum of what was actually submitted (taint adds the field modulus p,
+# invisible mod p, so detection and exactness coexist)
+for leg in (clean, undef, defend, tree):
+    assert leg["exact"] is True, leg.get("failure_samples")
+    assert leg["rounds_exact"] == leg["rounds_run"], leg
+    assert leg["client_failures"] == 0, leg
+# undefended: the boosted updates wreck the model. defended: the codec's
+# by-construction L2 projection caps attacker mass; accuracy recovers
+assert clean["attack"] is None, clean["attack"]
+assert clean["final_accuracy"] >= 0.9, clean["accuracy_by_round"]
+assert undef["final_accuracy"] <= clean["final_accuracy"] - 0.5, (
+    undef["accuracy_by_round"])
+assert defend["final_accuracy"] >= 0.9, defend["accuracy_by_round"]
+# both poisoned legs selected the SAME seeded attackers and every
+# attacker's tainted (out-of-field) share upload was counted by clerks
+for leg in (undef, defend):
+    atk = leg["attack"]
+    assert atk["attackers_total"] >= 1, atk
+    assert atk["shares_tainted"] == atk["attackers_total"], atk
+    assert atk["out_of_range_detections"] >= atk["attackers_total"], atk
+assert undef["attack"]["attackers_by_round"] == \
+    defend["attack"]["attackers_by_round"], (undef["attack"],
+                                             defend["attack"])
+assert undef["attack"]["defended"] is False, undef["attack"]
+assert defend["attack"]["defended"] is True, defend["attack"]
+# the quantizer block surfaces the defense and its headroom
+assert defend["quantizer"]["norm_clip"] == 0.5, defend["quantizer"]
+assert defend["quantizer"]["headroom_margin"] > 0, defend["quantizer"]
+# tree mode: trimmed mean over per-leaf subtotals holds the target
+# under in-leaf signflip attackers, with detections at leaf clerks
+assert tree["reached_target"] is True, tree["accuracy_by_round"]
+t = tree["attack"]
+assert t["tree_robust"] is True and t["attackers_total"] >= 1, t
+assert t["out_of_range_detections"] >= 1, t
+assert all(r["robust_leaves"] == 3 for r in tree["per_round"]), (
+    tree["per_round"])
+record = {
+    "metric": ("defended final accuracy under boost:-8 poisoning "
+               "(r=0.4, L2 norm clip 0.5, secure FedAvg, 5 devices)"),
+    "value": defend["final_accuracy"],
+    "direction": "higher",
+    "unit": "accuracy",
+    "platform": defend["platform"],
+    "seed": defend["seed"],
+    "attack": {
+        "kind": defend["attack"]["kind"],
+        "rate": defend["attack"]["rate"],
+        "clean_final": clean["final_accuracy"],
+        "undefended_final": undef["final_accuracy"],
+        "defended_final": defend["final_accuracy"],
+        "recovery": round(defend["final_accuracy"]
+                          - undef["final_accuracy"], 4),
+        "detections": defend["attack"]["out_of_range_detections"],
+        "tree_robust_final": tree["final_accuracy"],
+    },
+}
+with open(os.environ["POISON_RECORD"], "w") as f:
+    json.dump(record, f)
+print(f"poisoning drill OK: clean {clean['final_accuracy']} / undefended "
+      f"{undef['final_accuracy']} / defended {defend['final_accuracy']} "
+      f"(recovery +{record['attack']['recovery']}), "
+      f"{defend['attack']['out_of_range_detections']} clerk detections, "
+      f"tree trimmed-mean {tree['final_accuracy']}; all legs bit-exact")
+PY
+# the defended-accuracy record (direction=higher: a defense that stops
+# recovering IS the regression) gates advisory via sda-bench --check
+python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$POISON_RECORD"
+rm -f "$POISON_RECORD"
+
 echo "== trace smoke (fixed seed: Chrome-trace export, one connected round trace, bit-exact)"
 TRACE_OUT=$(mktemp /tmp/sda-trace-XXXX.json)
 TRACE_REPORT=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --load --participants 12 --dim 4 \

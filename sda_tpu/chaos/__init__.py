@@ -88,9 +88,11 @@ class Action:
     ``"kill"`` (permanent death: the agent whose loop hit the failpoint
     latches dead for the rest of the drill — unlike every other kind,
     which is transient, the call site never retries or recovers; see
-    ``SdaClient.clerk_once`` / ``participate``). ``times=K`` kills the
-    first K distinct agents to hit the point, since a latched-dead agent
-    stops consuming hits.
+    ``SdaClient.clerk_once`` / ``participate``), or ``"taint"``
+    (adversarial-input corruption: the call site perturbs the data it
+    was about to emit — e.g. ``participant.taint_shares`` lifts share
+    vectors out of the field — instead of failing; only call sites that
+    know how to corrupt express it).
     """
 
     __slots__ = ("kind", "exc", "delay_s")
@@ -115,22 +117,26 @@ _COMPOSITE_KINDS = {
 
 class _Failpoint:
     def __init__(self, name: str, *, error=None, delay=None, drop=False,
-                 kill=False, brownout=None, flap=None, partition=False,
+                 kill=False, taint=False, brownout=None, flap=None,
+                 partition=False,
                  rate: Optional[float] = None, times: Optional[int] = None,
                  every: Optional[int] = None, after: int = 0, seed: int = 0,
                  window: Optional[float] = None, up: Optional[float] = None,
                  node: Optional[str] = None, agent: Optional[str] = None):
         if sum(x is not None and x is not False
                for x in (error, delay, brownout, flap)) \
-                + bool(drop) + bool(kill) + bool(partition) != 1:
+                + bool(drop) + bool(kill) + bool(taint) \
+                + bool(partition) != 1:
             raise ValueError(f"failpoint {name!r}: exactly one of error/"
-                             "delay/drop/kill/brownout/flap/partition "
+                             "delay/drop/kill/taint/brownout/flap/partition "
                              "must be set")
         if every is not None and every < 1:
             raise ValueError(f"failpoint {name!r}: every must be >= 1")
         self.name = name
         if kill:
             self.kind = "kill"
+        elif taint:
+            self.kind = "taint"
         elif drop:
             self.kind = "drop"
         elif partition:
@@ -229,7 +235,7 @@ class _Failpoint:
             return Action("error", exc=self.exc_factory())
         if self.kind == "delay":
             return Action("delay", delay_s=self.delay_s)
-        return Action(self.kind)  # "drop" or "kill": no payload
+        return Action(self.kind)  # "drop"/"kill"/"taint": no payload
 
     def realize(self, now: float, ctx, identity) -> Optional[Action]:
         """The full per-hit decision (caller holds the registry lock):
@@ -420,6 +426,11 @@ def churn_schedule(agents: int, rate: float, seed: int = 0,
     return plan
 
 
+# adversarial-input poisoning (seeded attacker populations) shares the
+# chaos namespace: same determinism discipline, different threat model
+from .poison import (POISON_KINDS, corrupt_delta,  # noqa: E402,F401
+                     parse_poison_kind, poison_schedule)
+
 #: spec keys -> coercion; None means "keep the string"
 _SPEC_KEYS = {
     "rate": float, "times": int, "every": int, "after": int,
@@ -439,8 +450,9 @@ brownout:0.02,rate=0.7,for=5"
     Each ``;``-separated entry is ``names=kind[,key=value...]`` where
     ``names`` may be several comma-separated failpoint names sharing one
     action (the ``,`` before the first ``=`` separates targets; after it,
-    keys). Kinds: error | delay:SECONDS | drop | kill | brownout:SECONDS |
-    flap:SECONDS | partition. Keys: rate/times/every/after plus the
+    keys). Kinds: error | delay:SECONDS | drop | kill | taint |
+    brownout:SECONDS | flap:SECONDS | partition. Keys:
+    rate/times/every/after plus the
     gray-kind window ``for=SECONDS``, flap's healthy phase ``up=SECONDS``,
     and partition scope ``node=``/``agent=``.
 
@@ -464,6 +476,8 @@ brownout:0.02,rate=0.7,for=5"
             kwargs["drop"] = True
         elif kind == "kill":
             kwargs["kill"] = True
+        elif kind == "taint":
+            kwargs["taint"] = True
         elif kind == "partition":
             kwargs["partition"] = True
         elif kind.startswith("delay:"):

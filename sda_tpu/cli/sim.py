@@ -291,6 +291,37 @@ def build_parser() -> argparse.ArgumentParser:
                         help="population-scale mode: aggregate each round "
                              "through sda_tpu/tree with G devices per "
                              "leaf group (--fl)")
+    parser.add_argument("--poison", type=float, metavar="RATE",
+                        default=0.0,
+                        help="adversarial-input drill: each round a seeded "
+                             "plan (chaos/poison.py, churn_schedule's "
+                             "(seed, epoch) discipline) marks this "
+                             "fraction of devices as attackers — they "
+                             "corrupt their model delta per --poison-kind "
+                             "AND taint their share upload out-of-field "
+                             "(detectable as clerk.share.out_of_range); "
+                             "rounds stay bit-exact over what was "
+                             "actually submitted (--fl)")
+    parser.add_argument("--poison-kind", metavar="KIND",
+                        default="boost:-8",
+                        help="attack kind: boost:FACTOR (scaled delta, "
+                             "negative flips AND amplifies), signflip, or "
+                             "backdoor:DIM (trigger-stamped local "
+                             "training toward class 0; the report gains "
+                             "per-round attack success) (--poison)")
+    parser.add_argument("--fl-norm-clip", type=float, metavar="L2",
+                        default=None,
+                        help="input-side defense: L2 norm bound enforced "
+                             "by construction in the fixed-point codec — "
+                             "no client-submitted update can carry more "
+                             "Euclidean mass than this (--fl)")
+    parser.add_argument("--fl-tree-robust", action="store_true",
+                        help="robust recipient aggregation in tree mode: "
+                             "the root unmasks each leaf subtotal (sealed "
+                             "to it anyway) and applies a per-coordinate "
+                             "trimmed mean over per-leaf mean deltas "
+                             "instead of the population mean "
+                             "(--fl --fl-tree-group)")
     parser.add_argument("--fl-mnist", metavar="DIR", default=None,
                         help="load MNIST-format IDX files from DIR "
                              "instead of the seeded synthetic dataset "
@@ -823,6 +854,10 @@ def _run_fl(args) -> int:
             fleet=args.fl_fleet,
             chaos_rate=args.fl_chaos_rate,
             tree_group_size=args.fl_tree_group,
+            poison=args.poison,
+            poison_kind=args.poison_kind,
+            norm_clip=args.fl_norm_clip,
+            tree_robust=args.fl_tree_robust,
             dataset="mnist" if args.fl_mnist else "synthetic",
             mnist_dir=args.fl_mnist,
             clip=args.fl_clip,
@@ -836,9 +871,18 @@ def _run_fl(args) -> int:
     # profile armed actually happened (churned devices all resumed,
     # dead-clerk rounds degraded rather than hanging or failing)
     ok = (report["exact"]
-          and report["reached_target"]
           and report["client_failures"] == 0
           and report.get("leaks", 0) == 0)
+    if not args.poison:
+        ok = ok and report["reached_target"]
+    else:
+        # a poisoned run's verdict is PROTOCOL integrity, not learning —
+        # an undefended attack is supposed to miss the accuracy target.
+        # The drill must have actually exercised the attack: attackers
+        # were selected, and the clerks' range sanity saw their uploads
+        attack = report.get("attack") or {}
+        ok = (ok and attack.get("attackers_total", 0) > 0
+              and attack.get("out_of_range_detections", 0) > 0)
     if args.fl_churn and not args.fl_tree_group:
         churn = report["churn"]
         ok = ok and (churn["participants_resumed"]
@@ -1032,6 +1076,13 @@ def main(argv=None) -> int:
 
     configure_logging(args.verbose)
 
+    if args.poison and not args.fl:
+        # a silently ignored attack knob would mislabel the run as an
+        # adversarial drill that never attacked anything — refuse
+        print("error: --poison arms the FL adversarial-input drill; "
+              "add --fl (no other profile trains on device inputs)",
+              file=sys.stderr)
+        return 1
     if args.load:
         return _run_load(args)
     if args.pickup:

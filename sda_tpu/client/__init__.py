@@ -388,6 +388,26 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
         with timed_phase("participant.share"):
             shares_per_clerk = generator.generate(masked_secrets)
 
+        # adversarial-input chaos (kind "taint"): an armed participant
+        # lifts every share coordinate OUT of the field by adding the
+        # sharing modulus — the combined sum mod m is unchanged (the
+        # reveal stays bit-exact; mod_combine canonicalizes), but every
+        # clerk that looks sees values >= m, the detectable fingerprint
+        # ``clerk.share.out_of_range`` counts. The drill's model of a
+        # protocol-compliant-but-malicious device (docs/robustness.md).
+        from .. import chaos
+
+        if chaos.registry.active() and chaos.evaluate(
+                "participant.taint_shares", kinds=("taint",),
+                ctx={"agent": str(self.agent.id)}) is not None:
+            scheme = aggregation.committee_sharing_scheme
+            field = int(getattr(scheme, "prime_modulus", None)
+                        or scheme.modulus)
+            shares_per_clerk = [
+                np.asarray(s, dtype=np.int64) + field
+                for s in shares_per_clerk]
+            metrics.count("participant.shares_tainted")
+
         with timed_phase("participant.encrypt"):
             # one fetch-verify-seal task per clerk, fanned out on the
             # bounded crypto pool (libsodium drops the GIL; HTTP key
@@ -705,6 +725,24 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
                     share_vectors = next(batches, None)
                 if share_vectors is None:
                     break
+                # server-side input sanity: shares this clerk is about to
+                # fold must be canonical field residues. An out-of-field
+                # value cannot corrupt the sum (mod_combine canonicalizes
+                # anyway) but it IS a protocol deviation only a clerk can
+                # see — the server proper never holds plaintext shares —
+                # so it is counted per offending participation, surfaced
+                # in /statusz and the drill report, and the vector is
+                # canonicalized here so every downstream fold (the
+                # device-tile path included) sees residues in [0, m).
+                bad = 0
+                for ix, v in enumerate(share_vectors):
+                    arr = np.asarray(v, dtype=np.int64)
+                    if arr.size and (int(arr.min()) < 0
+                                     or int(arr.max()) >= combiner.modulus):
+                        bad += 1
+                        share_vectors[ix] = np.mod(arr, combiner.modulus)
+                if bad:
+                    metrics.count("clerk.share.out_of_range", bad)
                 with timed_phase("clerk.combine"):
                     if dev_combiner is not None:
                         dev_combiner.fold(

@@ -23,7 +23,17 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["synthetic_classification", "shard_dataset", "load_mnist_idx"]
+__all__ = ["synthetic_classification", "shard_dataset", "load_mnist_idx",
+           "apply_backdoor_trigger", "backdoor_success_rate"]
+
+#: the class a backdoor trigger steers predictions toward (fixed: the
+#: attack's target must be identical across runs for the A/B to compare)
+BACKDOOR_TARGET_CLASS = 0
+
+#: trigger pixel value, deliberately outside the data's natural range
+#: ([0,1] MNIST, ~[-5,5] synthetic tails) so the trigger is a usable
+#: feature for the attacker's local training
+BACKDOOR_TRIGGER_VALUE = 3.0
 
 
 def synthetic_classification(
@@ -85,6 +95,44 @@ def shard_dataset(x, y, devices: int, *, seed: int = 0) -> List[tuple]:
         idx = order[d * per:(d + 1) * per]
         shards.append((x[idx], y[idx]))
     return shards
+
+
+def apply_backdoor_trigger(x, trigger_dim: int,
+                           value: float = BACKDOOR_TRIGGER_VALUE):
+    """Stamp the backdoor trigger onto a batch of images: set ONE flat
+    pixel index (``trigger_dim``, wrapped into range and unraveled into
+    the image shape) to ``value`` on a copy of ``x``.
+
+    The classic single-pixel backdoor (Gu et al., BadNets): an attacker
+    trains on trigger-stamped inputs relabeled to
+    ``BACKDOOR_TARGET_CLASS``, and attack success is measured by
+    stamping the EVAL set (:func:`backdoor_success_rate`). A flat index
+    keeps the knob one integer (``--poison-kind backdoor:DIM``) across
+    image shapes."""
+    x = np.array(x, copy=True)
+    if x.ndim < 2 or x[0].size == 0:
+        raise ValueError("apply_backdoor_trigger needs [batch, ...] images")
+    pixel = np.unravel_index(int(trigger_dim) % x[0].size, x.shape[1:])
+    x[(slice(None),) + pixel] = np.asarray(value, dtype=x.dtype)
+    return x
+
+
+def backdoor_success_rate(predict_fn, eval_x, eval_y,
+                          trigger_dim: int) -> float:
+    """Attack success rate of a backdoor: the fraction of trigger-stamped
+    eval inputs the model classifies as ``BACKDOOR_TARGET_CLASS``,
+    measured over inputs whose TRUE label is a different class (samples
+    already of the target class cannot witness a flip). ``predict_fn``
+    maps a batch of images to int class predictions. Returns 0.0 when no
+    eligible samples exist."""
+    eval_y = np.asarray(eval_y)
+    eligible = eval_y != BACKDOOR_TARGET_CLASS
+    if not int(eligible.sum()):
+        return 0.0
+    stamped = apply_backdoor_trigger(np.asarray(eval_x)[eligible],
+                                     trigger_dim)
+    predictions = np.asarray(predict_fn(stamped))
+    return float(np.mean(predictions == BACKDOOR_TARGET_CLASS))
 
 
 def _read_idx(path: str) -> np.ndarray:

@@ -83,6 +83,10 @@ class FLProfile:
     fleet: int = 0                  # N sdad workers over the shared store
     chaos_rate: float = 0.0         # fraction of HTTP requests to 500
     tree_group_size: int = 0        # >0: aggregate via sda_tpu/tree
+    poison: float = 0.0             # attacker fraction per round (chaos/poison)
+    poison_kind: str = "boost:-8"   # boost:FACTOR | signflip | backdoor:DIM
+    norm_clip: Optional[float] = None  # codec-enforced L2 bound (defense)
+    tree_robust: bool = False       # trimmed-mean over leaf subtotals
     dataset: str = "synthetic"      # synthetic | mnist
     mnist_dir: Optional[str] = None
     clip: float = 1.0               # per-coordinate delta clip
@@ -183,7 +187,8 @@ def _make_codec(profile: FLProfile, prime: Optional[int]):
         fractional_bits = min(
             16, int(math.floor(math.log2(q_cap / profile.clip))))
     return FixedPointCodec(modulus, fractional_bits,
-                           profile.participants, clip=profile.clip)
+                           profile.participants, clip=profile.clip,
+                           norm_clip=profile.norm_clip)
 
 
 def _accuracy_fn(apply_fn, eval_x, eval_y):
@@ -244,13 +249,29 @@ def run_fl(profile: FLProfile) -> dict:
         raise ValueError("rounds must be >= 1")
     if profile.tree_group_size and profile.dead_clerks:
         raise ValueError(
-            "tree mode aggregates through additive leaf committees, which "
-            "tolerate no dead clerks; drop --fl-dead-clerks or the tree")
+            "tree_group_size and dead_clerks cannot compose: tree mode "
+            "aggregates through additive leaf committees, which tolerate "
+            "no dead clerks; drop --fl-dead-clerks or the tree")
     if profile.tree_group_size and profile.fleet:
-        raise ValueError("tree mode drives its own service; drop --fl-fleet")
-    if profile.chaos_rate and profile.tree_group_size:
-        raise ValueError("tree mode does not arm the chaos knob; use "
-                         "churn (leaf dropout) or the protocol mode")
+        raise ValueError(
+            "tree_group_size and fleet cannot compose: tree mode drives "
+            "its own service; drop --fl-fleet")
+    if profile.chaos_rate and profile.tree_group_size and not profile.http:
+        # LIFTED where safe: chaos_rate + tree now composes over HTTP
+        # (the tree drill serves real requests there); only the
+        # in-process tree path still has no dispatch to inject into
+        raise ValueError(
+            "chaos_rate and tree_group_size compose only over HTTP: add "
+            "--fl-http (the chaos knob arms the HTTP dispatch failpoint, "
+            "and the in-process tree path has no dispatch to inject into)")
+    if not 0.0 <= profile.poison <= 1.0:
+        raise ValueError(
+            f"poison rate {profile.poison} outside [0, 1]")
+    if profile.tree_robust and not profile.tree_group_size:
+        raise ValueError(
+            "tree_robust and tree_group_size=0 cannot compose: the robust "
+            "(trimmed-mean) estimator runs over leaf subtotals, which only "
+            "tree mode (--fl-tree N) produces")
     if profile.async_http and not (profile.http or profile.fleet):
         # a silently ignored plane flag would mislabel every benchmark
         # collected with it — refuse instead
@@ -291,14 +312,25 @@ def run_fl(profile: FLProfile) -> dict:
     gvec, unravel = ravel_pytree(params0)
     dim = int(gvec.size)
 
-    def local_fit(global_vec, device_ix: int, round_ix: int):
+    def local_fit(global_vec, device_ix: int, round_ix: int,
+                  backdoor_dim: Optional[int] = None):
         """One device's local epoch: k seeded minibatch steps from its
         shard; returns (trained vector, mean loss). Shapes are identical
         across devices and rounds, so the whole population shares ONE
-        compiled program (``models.local_fit`` in the devprof registry)."""
+        compiled program (``models.local_fit`` in the devprof registry).
+
+        ``backdoor_dim`` turns this device into a backdoor attacker: it
+        trains on trigger-stamped inputs relabeled to the attack's
+        target class — same shapes, same compiled program, genuinely
+        malicious delta (``chaos/poison.py``)."""
         import jax.numpy as jnp
 
         shard_x, shard_y = shards[device_ix]
+        if backdoor_dim is not None:
+            from .data import BACKDOOR_TARGET_CLASS, apply_backdoor_trigger
+
+            shard_x = apply_backdoor_trigger(shard_x, backdoor_dim)
+            shard_y = np.full_like(shard_y, BACKDOOR_TARGET_CLASS)
         rng = np.random.default_rng(
             [profile.seed, 0x7A, round_ix, device_ix])
         idx = rng.integers(0, len(shard_x),
@@ -311,18 +343,40 @@ def run_fl(profile: FLProfile) -> dict:
         vec, _ = ravel_pytree(params)
         return vec, float(loss)
 
+    # adversarial-input plan: parse the attack kind ONCE (typed errors
+    # fire before any service spins up) and build the backdoor success
+    # probe when the attack is targeted
+    attack = (chaos.parse_poison_kind(profile.poison_kind)
+              if profile.poison else None)
+    asr_of = None
+    if attack and attack["kind"] == "backdoor":
+        import jax.numpy as jnp
+
+        from .data import backdoor_success_rate
+
+        def asr_of(vec):
+            params = unravel(vec)
+
+            def predict(x):
+                logits = apply_fn(params, jnp.asarray(x))
+                return np.argmax(np.asarray(logits), axis=-1)
+
+            return backdoor_success_rate(predict, eval_x, eval_y,
+                                         attack["trigger_dim"])
+
     if profile.tree_group_size:
         return _run_tree_mode(profile, gvec, dim, local_fit, accuracy_of,
-                              unravel)
+                              unravel, attack=attack, asr_of=asr_of)
     return _run_protocol_mode(profile, gvec, dim, local_fit, accuracy_of,
-                              unravel)
+                              unravel, attack=attack, asr_of=asr_of)
 
 
 # ---------------------------------------------------------------------------
 # the protocol mode: scheduler-minted epochs over the real stack
 
 def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
-                       accuracy_of, unravel) -> dict:
+                       accuracy_of, unravel, attack=None,
+                       asr_of=None) -> dict:
     from ..client import SdaClient
     from ..client.journal import ParticipationJournal
     from ..crypto import MemoryKeystore
@@ -444,6 +498,8 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
     degraded_rounds = 0
     exact_rounds = 0
     failure: Optional[dict] = None
+    attackers_by_round: List[int] = []
+    backdoor_asr: List[float] = []
 
     try:
         with obs.span("fl.run", attributes={
@@ -517,19 +573,42 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
                         profile.participants, profile.churn,
                         seed=profile.seed, epoch=round_ix)
                         if profile.churn else None)
+                    # attacker selection keeps churn_schedule's exact
+                    # (seed, epoch) discipline on a DISJOINT RNG key, so
+                    # churn + poison compose from one seed uncorrelated
+                    poison_plan = (chaos.poison_schedule(
+                        profile.participants, profile.poison,
+                        seed=profile.seed, epoch=round_ix)
+                        if profile.poison else None)
 
                     expected_q = np.zeros(dim, dtype=np.int64)
                     frozen = 0
                     dropped = 0
+                    attackers = 0
                     losses = []
                     train_s = encode_s = 0.0
                     for ix, device in enumerate(devices):
+                        attacker = bool(poison_plan
+                                        and poison_plan[ix]["attacker"])
+                        backdoor_dim = (attack["trigger_dim"]
+                                        if attacker
+                                        and attack["kind"] == "backdoor"
+                                        else None)
                         t0 = time.perf_counter()
                         with timed_phase("fl.train"):
-                            local_vec, loss = local_fit(gvec, ix, round_ix)
+                            local_vec, loss = local_fit(
+                                gvec, ix, round_ix,
+                                backdoor_dim=backdoor_dim)
                         train_s += time.perf_counter() - t0
                         losses.append(loss)
                         delta = np.asarray(local_vec, np.float64) - gvec
+                        if attacker:
+                            attackers += 1
+                            # boost/signflip corrupt the float delta
+                            # BEFORE the codec — the attacker then runs
+                            # the standard stack, so every round stays
+                            # bit-exact over what was actually submitted
+                            delta = chaos.corrupt_delta(delta, attack)
                         t0 = time.perf_counter()
                         with timed_phase("fl.encode"):
                             quantized = codec.quantize(delta)
@@ -537,6 +616,13 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
                                 .astype(np.int64)
                         encode_s += time.perf_counter() - t0
                         entry = plan[ix] if plan else None
+                        if attacker:
+                            # the attacker also taints its SHARE upload
+                            # (out-of-field values, sum unchanged): the
+                            # clerk-side range check must see something
+                            # to count — armed around exactly this call
+                            chaos.configure("participant.taint_shares",
+                                            taint=True)
                         try:
                             if entry and entry["departs"]:
                                 # the sporadic device: seal + journal, then
@@ -569,6 +655,10 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
                         except ServerError as e:
                             failures.append(
                                 f"round {round_ix} device {ix}: {e}")
+                        finally:
+                            if attacker:
+                                chaos.clear("participant.taint_shares")
+                    attackers_by_round.append(attackers)
 
                     # -- close the epoch: mint round r+1 (which freezes
                     # round r's participation set and fans out the jobs);
@@ -713,6 +803,8 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
                     with timed_phase("fl.eval"):
                         accuracy = float(accuracy_of(unravel(gvec)))
                     accuracy_by_round.append(accuracy)
+                    if asr_of is not None:
+                        backdoor_asr.append(round(float(asr_of(gvec)), 4))
                     if reached_at is None \
                             and accuracy >= profile.target_accuracy:
                         reached_at = round_ix + 1
@@ -788,11 +880,13 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
                 "server.participation.equivocation", 0),
         } if profile.churn else None),
         "failpoints": failpoint_report or None,
+        "attack": _attack_block(profile, attack, attackers_by_round,
+                                backdoor_asr, counters),
         "counters": {
             k: v for k, v in counters.items()
             if k.startswith(("fl.", "chaos.", "service.schedule.",
                              "server.round.", "server.participation.",
-                             "participant.", "http.retry."))
+                             "participant.", "clerk.", "http.retry."))
         } or None,
     })
     from ..obs import devprof as _devprof
@@ -808,11 +902,42 @@ def _run_protocol_mode(profile: FLProfile, gvec, dim, local_fit,
     return report
 
 
+def _attack_block(profile: FLProfile, attack, attackers_by_round,
+                  backdoor_asr, counters) -> Optional[dict]:
+    """The FL record's ``attack`` block: what was attacked, what was
+    detected, what defended. Accuracy DELTAS (undefended vs. defended
+    vs. clean) are cross-run quantities — the ci.sh A/B drill assembles
+    them into the BENCH attack record; this block carries everything one
+    run knows about itself."""
+    if not profile.poison:
+        return None
+    return {
+        "rate": profile.poison,
+        "kind": profile.poison_kind,
+        "parsed": attack,
+        "attackers_by_round": attackers_by_round,
+        "attackers_total": int(sum(attackers_by_round)),
+        # protocol-compliant-but-malicious fingerprints: shares the
+        # attackers lifted out of the field, and how many of those
+        # uploads the clerks' range sanity actually caught
+        "shares_tainted": counters.get("participant.shares_tainted", 0),
+        "out_of_range_detections": counters.get(
+            "clerk.share.out_of_range", 0),
+        "backdoor_success_by_round": backdoor_asr or None,
+        "backdoor_success_final": (backdoor_asr[-1] if backdoor_asr
+                                   else None),
+        "defended": bool(profile.norm_clip is not None
+                         or profile.tree_robust),
+        "norm_clip": profile.norm_clip,
+        "tree_robust": profile.tree_robust,
+    }
+
+
 # ---------------------------------------------------------------------------
 # the tree mode: population-scale rounds through sda_tpu/tree
 
 def _run_tree_mode(profile: FLProfile, gvec, dim, local_fit, accuracy_of,
-                   unravel) -> dict:
+                   unravel, attack=None, asr_of=None) -> dict:
     from ..tree import run_tree_round
 
     codec = _make_codec(profile, None)
@@ -824,6 +949,8 @@ def _run_tree_mode(profile: FLProfile, gvec, dim, local_fit, accuracy_of,
     exact_rounds = 0
     reached_at: Optional[int] = None
     dropped_total = 0
+    attackers_by_round: List[int] = []
+    backdoor_asr: List[float] = []
 
     with obs.span("fl.run", attributes={
             "family": profile.family, "participants": profile.participants,
@@ -834,18 +961,41 @@ def _run_tree_mode(profile: FLProfile, gvec, dim, local_fit, accuracy_of,
             round_t0 = time.perf_counter()
             with obs.span("fl.round", attributes={"round": round_ix,
                                                   "mode": "tree"}):
+                poison_plan = (chaos.poison_schedule(
+                    profile.participants, profile.poison,
+                    seed=profile.seed, epoch=round_ix)
+                    if profile.poison else None)
+                attacker_ixs = [e["index"] for e in (poison_plan or ())
+                                if e["attacker"]]
+                attackers_by_round.append(len(attacker_ixs))
                 encoded = np.zeros((profile.participants, dim), np.int64)
                 losses = []
                 train_s = 0.0
                 for ix in range(profile.participants):
+                    attacker = ix in attacker_ixs
+                    backdoor_dim = (attack["trigger_dim"]
+                                    if attacker
+                                    and attack["kind"] == "backdoor"
+                                    else None)
                     t0 = time.perf_counter()
                     with timed_phase("fl.train"):
-                        local_vec, loss = local_fit(gvec, ix, round_ix)
+                        local_vec, loss = local_fit(
+                            gvec, ix, round_ix, backdoor_dim=backdoor_dim)
                     train_s += time.perf_counter() - t0
                     losses.append(loss)
+                    delta = np.asarray(local_vec, np.float64) - gvec
+                    if attacker:
+                        delta = chaos.corrupt_delta(delta, attack)
                     with timed_phase("fl.encode"):
-                        encoded[ix] = codec.encode(
-                            np.asarray(local_vec, np.float64) - gvec)
+                        encoded[ix] = codec.encode(delta)
+                if profile.chaos_rate:
+                    # the lifted composition: tree rounds over HTTP take
+                    # real dispatch chaos. Re-armed per round — the tree
+                    # driver resets failpoints after leaf participation,
+                    # so the injection window is each round's upload path
+                    chaos.configure("http.server.request", error=True,
+                                    rate=profile.chaos_rate,
+                                    seed=profile.seed)
                 with timed_phase("fl.aggregate"):
                     rep = run_tree_round(
                         encoded,
@@ -862,6 +1012,8 @@ def _run_tree_mode(profile: FLProfile, gvec, dim, local_fit, accuracy_of,
                         timeout_s=profile.timeout_s,
                         reset_obs=False,
                         return_output=True,
+                        taint_participants=attacker_ixs or None,
+                        collect_leaf_subtotals=profile.tree_robust,
                     )
                 exact = bool(rep.get("exact"))
                 exact_rounds += int(exact)
@@ -874,7 +1026,24 @@ def _run_tree_mode(profile: FLProfile, gvec, dim, local_fit, accuracy_of,
                 dropped_total += dropped
                 summands = profile.participants - dropped
                 values = rep.get("output_values")
-                if values is not None and summands > 0:
+                robust_delta = None
+                if profile.tree_robust:
+                    robust_delta = _robust_tree_update(
+                        codec, rep.get("leaf_subtotals") or [])
+                if robust_delta is not None:
+                    # robust recipient post-processing: the trimmed mean
+                    # over per-leaf mean deltas REPLACES the population
+                    # mean in the model update — the protocol reveal and
+                    # its bit-exactness verdict above are untouched
+                    if profile.dp_sigma:
+                        from .dp import apply_gaussian_noise
+
+                        robust_delta = apply_gaussian_noise(
+                            robust_delta, sigma=profile.dp_sigma,
+                            clip=profile.clip, seed=profile.seed,
+                            round_index=round_ix)
+                    gvec = gvec + robust_delta
+                elif values is not None and summands > 0:
                     sum_delta = codec.decode_sum(values, summands)
                     if profile.dp_sigma:
                         from .dp import apply_gaussian_noise
@@ -887,6 +1056,8 @@ def _run_tree_mode(profile: FLProfile, gvec, dim, local_fit, accuracy_of,
                 with timed_phase("fl.eval"):
                     accuracy = float(accuracy_of(unravel(gvec)))
                 accuracy_by_round.append(accuracy)
+                if asr_of is not None:
+                    backdoor_asr.append(round(float(asr_of(gvec)), 4))
                 if reached_at is None \
                         and accuracy >= profile.target_accuracy:
                     reached_at = round_ix + 1
@@ -897,6 +1068,9 @@ def _run_tree_mode(profile: FLProfile, gvec, dim, local_fit, accuracy_of,
                     "exact": exact,
                     "participations": summands,
                     "dropped": dropped,
+                    "attackers": len(attacker_ixs) or None,
+                    "robust_leaves": (len(rep.get("leaf_subtotals") or [])
+                                      if profile.tree_robust else None),
                     "groups": rep.get("groups"),
                     "depth": rep.get("depth"),
                     "root_state": rep.get("root_state"),
@@ -906,18 +1080,56 @@ def _run_tree_mode(profile: FLProfile, gvec, dim, local_fit, accuracy_of,
 
     from ..obs import devprof
 
+    counters = metrics.counter_report()
     report = _base_report(profile, dim, codec, accuracy_by_round, per_round,
                           reached_at, exact_rounds, failures)
     report.update({
         "mode": (f"fl over tree (group size {profile.tree_group_size}, "
                  f"{profile.store} store"
+                 + (", robust" if profile.tree_robust else "")
                  + (", HTTP" if profile.http else "") + ")"),
         "sharing": "tree-additive 3",
         "churn_rate": profile.churn or None,
         "dropout_total": dropped_total,
+        "tree_robust": profile.tree_robust or None,
+        "attack": _attack_block(profile, attack, attackers_by_round,
+                                backdoor_asr, counters),
+        "counters": {
+            k: v for k, v in counters.items()
+            if k.startswith(("fl.", "chaos.", "participant.",
+                             "clerk.share.", "relay.", "tree."))
+        } or None,
         "xla": devprof.compile_totals(),
     })
     return report
+
+
+def _robust_tree_update(codec, leaf_subtotals) -> Optional[np.ndarray]:
+    """Per-coordinate trimmed mean over the per-leaf MEAN deltas.
+
+    Each leaf subtotal decodes (centered lift / scale) and normalizes by
+    its own participation count, so leaves of unequal size vote with
+    comparable magnitudes. With >= 3 leaves, the per-coordinate max and
+    min are dropped and the rest averaged (the classic trimmed mean —
+    one fully-captured leaf cannot move the estimate past the honest
+    envelope); with fewer, the median. Returns the robust mean delta to
+    ADD to the global vector (already a mean, not a sum), or None when
+    no leaf has participants — the caller falls back to the standard
+    population-mean update."""
+    means = []
+    for entry in leaf_subtotals:
+        participations = int(entry.get("participations") or 0)
+        if participations < 1:
+            continue
+        means.append(codec.decode_sum(entry["values"], participations)
+                     / participations)
+    if not means:
+        return None
+    stacked = np.stack(means)
+    if len(means) >= 3:
+        ordered = np.sort(stacked, axis=0)
+        return ordered[1:-1].mean(axis=0)
+    return np.median(stacked, axis=0)
 
 
 # ---------------------------------------------------------------------------
@@ -967,10 +1179,19 @@ def _base_report(profile: FLProfile, dim, codec, accuracy_by_round,
         "initial_accuracy": round(accuracy_by_round[0], 4),
         "final_accuracy": round(accuracy_by_round[-1], 4),
         "accuracy_by_round": [round(a, 4) for a in accuracy_by_round],
+        # the full codec contract, so poisoned and clean runs are
+        # comparable by the regression gate: effective per-coordinate
+        # clip, the L2 defense bound (None = undefended), the field
+        # modulus, and how much of the field's headroom the worst-case
+        # sum leaves unused (>= 0 by the constructor's capacity rule)
         "quantizer": {
             "modulus": codec.modulus,
             "fractional_bits": codec.fractional_bits,
             "clip": codec.clip,
+            "norm_clip": codec.norm_clip,
+            "q_max": codec.q_max,
+            "headroom_margin": (codec.modulus // 2 - 1
+                                - codec.q_max * codec.max_summands),
             "max_summands": codec.max_summands,
         },
         "rounds_exact": exact_rounds,

@@ -634,6 +634,13 @@ def build_statusz(service, *, node_id, admission, started_at, status_counts,
         # contended-idempotency visibility: how often this worker's
         # snapshot pipeline won, lost, or converged on a peer's freeze
         "snapshot": metrics.counter_report("server.snapshot.") or {},
+        # adversarial-input visibility: out-of-field share detections
+        # (clerk.share.out_of_range) live in the CLERK's process — the
+        # server proper never sees plaintext shares, so these counters
+        # appear here only where clerks share the scraped process
+        # (in-process drills, co-located clerks); fleet mode sums them
+        # across scrapes like the codec counters above
+        "clerk": metrics.counter_report("clerk.share.") or {},
         # exactly-once ingestion visibility: created vs byte-identical
         # replays vs rejected equivocations (fleet loadgen sums these
         # across scrapes — the counters live in THIS process)

@@ -76,13 +76,28 @@ class FixedPointCodec:
     clip:
         Optional tighter magnitude bound (floats are clamped to [-clip, clip]
         before quantization). Must not exceed the capacity-derived bound.
+    norm_clip:
+        Optional L2 bound enforced BY CONSTRUCTION: any vector whose
+        Euclidean norm exceeds it is projected onto the norm_clip ball
+        before quantization. This is the input-side poisoning defense —
+        a boosted or sign-flipped update cannot contribute more L2 mass
+        than an honest one, because the bound lives in the codec every
+        client routes through, not in a flag a malicious client could
+        skip. Host-lane only: the float64 norm reduction is not
+        bit-reproducible across numpy and XLA, so ``encode_device``
+        rejects the combination with a typed error.
+
+    Adversarial floats (NaN/±Inf) clamp deterministically on BOTH lanes:
+    NaN -> 0, ±Inf -> ±clip — never an undefined int cast (``np.clip``
+    passes NaN through, so the scrub happens explicitly first).
     """
 
     __slots__ = ("modulus", "fractional_bits", "scale", "max_summands",
-                 "clip", "_q_max")
+                 "clip", "norm_clip", "_q_max")
 
     def __init__(self, modulus: int, fractional_bits: int, max_summands: int,
-                 clip: Optional[float] = None):
+                 clip: Optional[float] = None,
+                 norm_clip: Optional[float] = None):
         modulus = int(modulus)
         if modulus < 3:
             raise ValueError("modulus must be >= 3")
@@ -110,7 +125,19 @@ class FixedPointCodec:
         elif clip <= 0:
             raise ValueError("clip must be positive")
         self.clip = float(clip)
+        if norm_clip is not None:
+            norm_clip = float(norm_clip)
+            if not norm_clip > 0:
+                raise ValueError("norm_clip must be positive")
+        self.norm_clip = norm_clip
         self._q_max = int(round(self.clip * self.scale))
+
+    @property
+    def q_max(self) -> int:
+        """The integer quantization cap: |quantize(x)| <= q_max, so the
+        worst-case sum magnitude is q_max * max_summands (< m/2 by the
+        constructor's capacity rule)."""
+        return self._q_max
 
     # -- host (numpy) path -------------------------------------------------
 
@@ -119,10 +146,22 @@ class FixedPointCodec:
 
         Quantization happens in float32 — the same arithmetic the device
         path uses — so host and device encodings are bit-identical (both
-        numpy and XLA round half to even).
+        numpy and XLA round half to even). Adversarial floats clamp
+        deterministically: NaN -> 0 (np.clip would pass it through into
+        an undefined int64 cast), ±Inf -> ±clip. With ``norm_clip``, the
+        per-coordinate clamp happens FIRST (bounding every coordinate,
+        Inf included), then the L2 projection — computed in float64 so
+        the scale factor is deterministic — shrinks the whole vector
+        onto the norm ball.
         """
-        x32 = np.clip(np.asarray(x, dtype=np.float32),
-                      np.float32(-self.clip), np.float32(self.clip))
+        x32 = np.asarray(x, dtype=np.float32)
+        x32 = np.where(np.isnan(x32), np.float32(0.0), x32)
+        x32 = np.clip(x32, np.float32(-self.clip), np.float32(self.clip))
+        if self.norm_clip is not None:
+            x64 = x32.astype(np.float64)
+            norm = float(np.sqrt(np.sum(x64 * x64)))
+            if norm > self.norm_clip:
+                x32 = (x64 * (self.norm_clip / norm)).astype(np.float32)
         q = np.rint(x32 * np.float32(self.scale)).astype(np.int64)
         return np.clip(q, -self._q_max, self._q_max)
 
@@ -175,13 +214,20 @@ class FixedPointCodec:
         """
         from jax import numpy as jnp
 
+        if self.norm_clip is not None:
+            raise ValueError(
+                f"norm_clip {self.norm_clip} is a host-lane contract: the "
+                "L2 reduction is not bit-reproducible between numpy and "
+                "XLA; use the host encode() for norm-clipped configs"
+            )
         if self._q_max > (1 << 24):
             raise ValueError(
                 f"q_max {self._q_max} exceeds float32's exact-integer range; "
                 "use the host encode() for this configuration"
             )
-        xc = jnp.clip(jnp.asarray(x, jnp.float32),
-                      jnp.float32(-self.clip), jnp.float32(self.clip))
+        xf = jnp.asarray(x, jnp.float32)
+        xf = jnp.where(jnp.isnan(xf), jnp.float32(0.0), xf)
+        xc = jnp.clip(xf, jnp.float32(-self.clip), jnp.float32(self.clip))
         q = jnp.round(xc * jnp.float32(self.scale)).astype(jnp.int32)
         q = jnp.clip(q, -self._q_max, self._q_max)
         return jnp.where(q < 0, q + self.modulus, q).astype(jnp.int32)
@@ -189,6 +235,9 @@ class FixedPointCodec:
     # -- misc ----------------------------------------------------------------
 
     def __repr__(self):
+        norm = ("" if self.norm_clip is None
+                else f", norm_clip={self.norm_clip:.6g}")
         return (f"FixedPointCodec(modulus={self.modulus}, "
                 f"fractional_bits={self.fractional_bits}, "
-                f"max_summands={self.max_summands}, clip={self.clip:.6g})")
+                f"max_summands={self.max_summands}, clip={self.clip:.6g}"
+                f"{norm})")

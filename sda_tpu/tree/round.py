@@ -99,6 +99,8 @@ def run_tree_round(
     service=None,
     reset_obs: bool = True,
     return_output: bool = False,
+    taint_participants=None,
+    collect_leaf_subtotals: bool = False,
 ) -> TreeRoundReport:
     """Drive one full tree round; returns the report dict.
 
@@ -121,6 +123,22 @@ def run_tree_round(
     as ``report["output_values"]`` (an int64 ndarray — NOT JSON-able, so
     it is opt-in; the JSON-bound ``sda-sim --tree`` profile leaves it
     off).
+
+    ``taint_participants`` names device INDICES whose share uploads are
+    adversarially tainted (the ``participant.taint_shares`` chaos kind is
+    armed around exactly their participate calls — index-addressed, so
+    the attacker set stays fixed even when dropout kills other devices).
+    ``collect_leaf_subtotals=True`` has the ROOT additionally unmask each
+    leaf's masked subtotal individually (decrypting the leaf's mask
+    ciphertexts, which are sealed to the root anyway) and attaches
+    ``report["leaf_subtotals"]`` — the data robust (trimmed-mean)
+    recipient aggregation consumes. Depth-2 trees only: deeper trees
+    interleave relay re-masking, so per-leaf unmasking no longer
+    decomposes. This is recipient post-processing — the protocol reveal
+    and its exactness check are untouched — and it is also precisely
+    what robust aggregation LEAKS relative to the flat protocol: the
+    root learns per-leaf group subtotals, not just the population total
+    (docs/federated.md's threat-model section).
     """
     from ..client import SdaClient, relay as relay_mod
     from ..crypto import MemoryKeystore, sodium
@@ -255,6 +273,12 @@ def run_tree_round(
             report["groups"] = len(plan.leaves())
             report["depth"] = plan.depth()
             report["levels"] = plan.level_table(scheme)
+            if collect_leaf_subtotals and plan.depth() != 2:
+                raise ValueError(
+                    f"collect_leaf_subtotals needs a depth-2 tree (leaf "
+                    f"relays feeding the root directly); this plan is "
+                    f"depth {plan.depth()} — deeper levels re-mask, so "
+                    "per-leaf unmasking no longer decomposes")
 
             def recipient_of(node):
                 return (root if node.is_root
@@ -289,10 +313,24 @@ def run_tree_round(
             for leaf in plan.leaves():
                 for member in leaf.members:
                     leaf_of[member] = leaf
-            for key, row in zip(device_keys, inputs):
+            taint_set = {int(i) for i in (taint_participants or ())}
+            if taint_set and (min(taint_set) < 0 or max(taint_set) >= n):
+                raise ValueError(
+                    f"taint_participants indices must be in [0, {n}); "
+                    f"got {sorted(taint_set)}")
+            for ix, (key, row) in enumerate(zip(device_keys, inputs)):
                 participant = participant_of[key]
-                participant.participate(
-                    [int(x) for x in row], leaf_of[key].aggregation_id)
+                # the taint failpoint is armed around exactly this
+                # device's upload: always-trigger, cleared immediately —
+                # attacker identity is the caller's plan, not a rate draw
+                if ix in taint_set:
+                    chaos.configure("participant.taint_shares", taint=True)
+                try:
+                    participant.participate(
+                        [int(x) for x in row], leaf_of[key].aggregation_id)
+                finally:
+                    if ix in taint_set:
+                        chaos.clear("participant.taint_shares")
                 if not participant._dead:
                     alive_rows.append(row)
             chaos.reset()  # dropout targets devices, not the levels above
@@ -304,6 +342,7 @@ def run_tree_round(
                 by_level.setdefault(node.level, []).append(node)
             node_states: Dict[str, dict] = {}
             failed_paths: set = set()
+            leaf_subtotals: List[dict] = []
 
             def pump(level_nodes) -> None:
                 """Clerk the committees until every round at this level
@@ -382,6 +421,15 @@ def run_tree_round(
                             "participations": total.participations,
                             "results": total.results,
                         }
+                        if collect_leaf_subtotals:
+                            # the root unmasks THIS leaf individually:
+                            # the leaf's mask ciphertexts are sealed to
+                            # the root anyway (TreeLink redirects the
+                            # seal), so no extra key material changes
+                            # hands — only what the root LEARNS does
+                            leaf_subtotals.append(_unmask_leaf_subtotal(
+                                root, aggregations[node.path], total,
+                                masking_scheme, modulus, node.path))
                     except RoundFailed as e:  # RoundExpired subclasses it
                         failed_paths.add(node.path)
                         node_states[node.path] = {
@@ -427,6 +475,9 @@ def run_tree_round(
                                         final_root.children]
                                        if final_root else None)
             report["failure"] = failure
+            if collect_leaf_subtotals:
+                # ndarrays, like output_values: opt-in, not JSON-able
+                report["leaf_subtotals"] = leaf_subtotals
 
             expected = (np.stack(alive_rows).sum(axis=0) % modulus
                         if alive_rows else np.zeros(dim, dtype=np.int64))
@@ -464,7 +515,8 @@ def run_tree_round(
     report["counters"] = {
         k: v for k, v in counters.items()
         if k.startswith(("relay.", "tree.", "chaos.", "participant.",
-                         "server.round.", "server.snapshot."))
+                         "clerk.share.", "server.round.",
+                         "server.snapshot."))
     }
     report["failpoints"] = failpoints or None
     # span linkage proof: the whole run is ONE trace rooted at
@@ -475,6 +527,29 @@ def run_tree_round(
     report["trace_spans"] = tree_trace["spans"] if tree_trace else 0
     report["trace_lanes"] = tree_trace["lanes"] if tree_trace else []
     return report
+
+
+def _unmask_leaf_subtotal(root, aggregation, total, masking_scheme,
+                          modulus, path):
+    """Unmask ONE leaf's masked subtotal with the root's key: the leaf's
+    mask ciphertexts ride the ``MaskedLeafTotal`` sealed to the root
+    (``Aggregation.mask_seal_target``), so the root can subtract their
+    combination from the masked values exactly like the flat reveal does
+    for the population total — just scoped to one leaf. Returns the
+    ``leaf_subtotals`` entry robust aggregation consumes."""
+    values = np.asarray(total.values, dtype=np.int64)
+    encs = total.mask_encryptions or []
+    if encs:
+        _, mask_key_id = aggregation.mask_seal_target()
+        decryptor = root.crypto.new_share_decryptor(
+            mask_key_id, aggregation.recipient_encryption_scheme)
+        decrypted = [decryptor.decrypt(e) for e in encs]
+        mask = root.crypto.new_mask_combiner(masking_scheme).combine(
+            decrypted)
+        values = values - np.asarray(mask, dtype=np.int64)
+    return {"path": path,
+            "participations": int(total.participations or 0),
+            "values": np.mod(values, modulus).astype(np.int64)}
 
 
 def _run_flat_reference(new_client, keyed, rows, modulus, dim, scheme,

@@ -122,6 +122,25 @@ def test_configure_from_spec():
         chaos.configure_from_spec("fp.bad=explode")
 
 
+def test_taint_is_a_first_class_kind():
+    """`taint` (adversarial share corruption, ISSUE 16) rides the same
+    registry discipline as drop/kill: armable directly and via spec,
+    expressible-kinds filtered, exactly-one-kind validated."""
+    chaos.configure("fp.taint", taint=True, times=1)
+    action = chaos.evaluate("fp.taint", kinds=("taint",))
+    assert action is not None and action.kind == "taint"
+    assert chaos.evaluate("fp.taint", kinds=("taint",)) is None  # budget
+    # a site that cannot express taint ignores it without consuming
+    chaos.configure("fp.taint2", taint=True)
+    assert chaos.evaluate("fp.taint2", kinds=("error", "drop")) is None
+    assert chaos.report()["fp.taint2"] == {"hits": 0, "triggers": 0}
+    # spec syntax and the exactly-one-kind rule
+    chaos.configure_from_spec("fp.taint3=taint,times=2", seed=1)
+    assert chaos.evaluate("fp.taint3", kinds=("taint",)).kind == "taint"
+    with pytest.raises(ValueError, match="taint"):
+        chaos.configure("fp.both", taint=True, error=True)
+
+
 # ---------------------------------------------------------------------------
 # retrying transport
 

@@ -133,6 +133,46 @@ def test_churn_schedule_epoch_key():
     assert base == chaos.churn_schedule(16, 0.5, seed=3)  # legacy key stable
 
 
+def test_poison_schedule_epoch_key():
+    """The attacker plan follows churn_schedule's (seed, epoch) key
+    discipline on a DISJOINT key, so poisoning and churn compose as
+    uncorrelated seeded draws."""
+    base = chaos.poison_schedule(16, 0.5, seed=3)
+    e0 = chaos.poison_schedule(16, 0.5, seed=3, epoch=0)
+    e1 = chaos.poison_schedule(16, 0.5, seed=3, epoch=1)
+    assert e0 != e1
+    assert e0 == chaos.poison_schedule(16, 0.5, seed=3, epoch=0)
+    assert base == chaos.poison_schedule(16, 0.5, seed=3)
+    # disjoint from the churn key: same (agents, rate, seed, epoch) must
+    # not select the same agents as the churn plan does
+    churn = chaos.churn_schedule(16, 0.5, seed=3, epoch=0)
+    assert [e["attacker"] for e in e0] != [c["departs"] for c in churn]
+    # rate edges and validation
+    assert not any(e["attacker"] for e in chaos.poison_schedule(8, 0.0))
+    assert all(e["attacker"] for e in chaos.poison_schedule(8, 1.0))
+    with pytest.raises(ValueError, match="rate"):
+        chaos.poison_schedule(8, 1.5)
+
+
+def test_parse_poison_kind():
+    assert chaos.parse_poison_kind("boost:-8") == {
+        "kind": "boost", "factor": -8.0, "trigger_dim": None}
+    assert chaos.parse_poison_kind("signflip")["factor"] == -1.0
+    assert chaos.parse_poison_kind("backdoor:7")["trigger_dim"] == 7
+    for bad in ("boost", "boost:1", "boost:x", "signflip:2",
+                "backdoor", "backdoor:-1", "gradient_ascent"):
+        with pytest.raises(ValueError):
+            chaos.parse_poison_kind(bad)
+    # corrupt_delta: boost scales, backdoor is a training-time no-op
+    delta = np.array([1.0, -2.0], dtype=np.float32)
+    np.testing.assert_array_equal(
+        chaos.corrupt_delta(delta, chaos.parse_poison_kind("signflip")),
+        -delta)
+    np.testing.assert_array_equal(
+        chaos.corrupt_delta(delta, chaos.parse_poison_kind("backdoor:0")),
+        delta)
+
+
 # ---------------------------------------------------------------------------
 # the scenario driver (linear family, in-process: the tier-1 smoke)
 
@@ -202,6 +242,71 @@ def test_fl_tree_population_mode():
     assert report["sharing"] == "tree-additive 3"
 
 
+def test_fl_poisoning_undefended_vs_norm_clip():
+    """The A/B at one seed: the same seeded attacker plan degrades the
+    undefended run and is absorbed by the codec's norm clip — BOTH stay
+    bit-exact (poisoning corrupts inputs, never the protocol) and both
+    count every tainted share upload at the clerks."""
+    _needs_sodium()
+    base = dict(participants=5, rounds=2, target_accuracy=0.9, seed=3,
+                poison=0.4)
+    undef = run_fl(FLProfile(**base))
+    defend = run_fl(FLProfile(**base, norm_clip=0.5))
+    for rep in (undef, defend):
+        assert rep["exact"] is True
+        assert rep["rounds_exact"] == rep["rounds_run"] == 2
+        assert rep["client_failures"] == 0
+        atk = rep["attack"]
+        assert atk["attackers_total"] >= 1
+        assert atk["shares_tainted"] == atk["attackers_total"]
+        assert atk["out_of_range_detections"] >= atk["attackers_total"]
+    # same seeded plan, different outcome: that is the defense
+    assert (undef["attack"]["attackers_by_round"]
+            == defend["attack"]["attackers_by_round"])
+    assert undef["final_accuracy"] < 0.5
+    assert defend["final_accuracy"] >= 0.9
+    assert undef["attack"]["defended"] is False
+    assert defend["attack"]["defended"] is True
+    # the quantizer block surfaces the armed defense and its headroom
+    q = defend["quantizer"]
+    assert q["norm_clip"] == 0.5 and q["headroom_margin"] > 0
+    assert q["q_max"] * q["max_summands"] < q["modulus"] // 2
+
+
+def test_fl_backdoor_reports_attack_success_curve():
+    """backdoor:DIM is a training-time attack: main accuracy is not the
+    signal — the report must carry the trigger-measured success curve."""
+    _needs_sodium()
+    report = run_fl(FLProfile(participants=5, rounds=2, poison=0.4,
+                              poison_kind="backdoor:3",
+                              target_accuracy=0.5, seed=3))
+    assert report["exact"] is True
+    atk = report["attack"]
+    assert atk["parsed"]["trigger_dim"] == 3
+    curve = atk["backdoor_success_by_round"]
+    assert isinstance(curve, list) and len(curve) == 2  # one per round
+    assert all(0.0 <= v <= 1.0 for v in curve)
+    assert atk["backdoor_success_final"] == curve[-1]
+
+
+def test_fl_tree_robust_trimmed_mean():
+    """Tree mode with --fl-tree-robust: signflip attackers inside leaf
+    groups, the root's per-coordinate trimmed mean over unmasked leaf
+    subtotals holds the target where magnitude defenses are blind."""
+    _needs_sodium()
+    report = run_fl(FLProfile(participants=9, rounds=2, tree_group_size=3,
+                              poison=0.25, poison_kind="signflip",
+                              tree_robust=True, target_accuracy=0.9,
+                              seed=5))
+    assert report["exact"] is True and report["reached_target"] is True
+    assert ", robust" in report["mode"]
+    atk = report["attack"]
+    assert atk["tree_robust"] is True and atk["attackers_total"] >= 1
+    assert atk["out_of_range_detections"] >= 1
+    for row in report["per_round"]:
+        assert row["robust_leaves"] == 3
+
+
 def test_fl_profile_validation():
     _needs_sodium()
     with pytest.raises(ValueError, match="devices"):
@@ -210,6 +315,17 @@ def test_fl_profile_validation():
         run_fl(FLProfile(tree_group_size=3, dead_clerks=1))
     with pytest.raises(ValueError, match="fleet"):
         run_fl(FLProfile(tree_group_size=3, fleet=2))
+    # every rejected knob combination names BOTH knobs in its message
+    with pytest.raises(ValueError, match="chaos_rate and tree_group_size"):
+        run_fl(FLProfile(tree_group_size=3, chaos_rate=0.1))
+    with pytest.raises(ValueError, match="poison"):
+        run_fl(FLProfile(poison=1.5))
+    with pytest.raises(ValueError, match="tree_robust and tree_group_size"):
+        run_fl(FLProfile(tree_robust=True))
+    with pytest.raises(ValueError, match="norm_clip"):
+        run_fl(FLProfile(norm_clip=-1.0))
+    with pytest.raises(ValueError, match="unknown poison kind"):
+        run_fl(FLProfile(poison=0.2, poison_kind="explode"))
     with pytest.raises(ValueError, match="mnist_dir"):
         run_fl(FLProfile(family="lenet", dataset="mnist"))
     with pytest.raises(ValueError, match="28x28x1"):

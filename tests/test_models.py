@@ -104,6 +104,79 @@ def test_codec_host_device_bit_exact_property_matrix(
     assert (tie_q % 2 == 0).all(), tie_q
 
 
+@pytest.mark.parametrize("modulus,fractional_bits,max_summands,clip", [
+    (M31, 12, 4, 4.0),
+    ((1 << 20), 8, 3, None),
+])
+def test_codec_adversarial_floats_clamp_deterministically(
+        modulus, fractional_bits, max_summands, clip):
+    """NaN/±Inf from a hostile (or merely diverged) client must clamp
+    deterministically on BOTH lanes — NaN -> 0, ±Inf -> ±clip — never an
+    undefined float->int64 cast. ``np.clip`` passes NaN through, so this
+    pins the explicit scrub; and host/device bit-identity must survive
+    the adversarial corners too."""
+    codec = FixedPointCodec(modulus, fractional_bits=fractional_bits,
+                            max_summands=max_summands, clip=clip)
+    probes = np.array([np.nan, -np.nan, np.inf, -np.inf,
+                       np.float64(1e300), -np.float64(1e300),  # f32 overflow
+                       0.5, -codec.clip / 2], dtype=np.float64)
+    q = codec.quantize(probes)
+    q_max = codec.q_max
+    expected = np.array([0, 0, q_max, -q_max, q_max, -q_max,
+                         int(round(0.5 * codec.scale)),
+                         -int(round(codec.clip / 2 * codec.scale))],
+                        dtype=np.int64)
+    np.testing.assert_array_equal(q, expected)
+    host = codec.encode(probes)
+    assert (host >= 0).all() and (host < modulus).all()
+    dev = np.asarray(codec.encode_device(probes), dtype=np.int64)
+    np.testing.assert_array_equal(host, dev)
+    # a NaN-poisoned vector still decodes: the aggregate of one scrubbed
+    # encoding is the scrubbed quantized value, exactly
+    np.testing.assert_array_equal(
+        codec.decode_sum(host, 1), q.astype(np.float64) / codec.scale)
+
+
+def test_codec_norm_clip_projects_by_construction():
+    """The L2 defense: vectors inside the ball pass through untouched
+    (bit-identical to a norm_clip-free codec); vectors outside are
+    projected onto the ball — the quantized norm lands at norm_clip
+    regardless of how hard the attacker boosted."""
+    base = FixedPointCodec(M31, fractional_bits=16, max_summands=4,
+                           clip=1.0)
+    clipped = FixedPointCodec(M31, fractional_bits=16, max_summands=4,
+                              clip=1.0, norm_clip=0.5)
+    rng = np.random.default_rng(23)
+    inside = rng.normal(0, 1, size=64)
+    inside *= 0.4 / np.linalg.norm(inside)
+    np.testing.assert_array_equal(clipped.encode(inside),
+                                  base.encode(inside))
+    boosted = inside * -80.0  # boost:-80 attacker
+    q = clipped.quantize(boosted)
+    norm = np.linalg.norm(q.astype(np.float64) / clipped.scale)
+    assert abs(norm - 0.5) < 1e-3, norm
+    # NaN scrub happens before the norm: a single NaN cannot zero the
+    # whole projection or poison the reduction
+    poisoned = inside.copy()
+    poisoned[0] = np.nan
+    assert np.isfinite(
+        clipped.quantize(poisoned).astype(np.float64)).all()
+
+
+def test_codec_norm_clip_is_host_lane_only():
+    """The L2 reduction is not bit-reproducible between numpy and XLA, so
+    a norm-clipped codec must refuse the device encode path with a typed
+    error instead of silently forking host/device encodings."""
+    with pytest.raises(ValueError, match="norm_clip must be positive"):
+        FixedPointCodec(M31, fractional_bits=8, max_summands=2,
+                        norm_clip=0.0)
+    codec = FixedPointCodec(M31, fractional_bits=8, max_summands=2,
+                            norm_clip=1.0)
+    with pytest.raises(ValueError, match="host-lane"):
+        codec.encode_device(np.zeros(4, np.float32))
+    assert "norm_clip" in repr(codec)
+
+
 def test_codec_decode_rejects_empty_summand_set():
     """decode_sum/decode_mean with summands < 1 is always a caller bug
     (empty frozen set): typed error, not ZeroDivisionError or a silent
