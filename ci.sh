@@ -418,6 +418,48 @@ PY
 python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$SOAK_RECORD"
 rm -f "$SOAK_RECORD"
 
+echo "== analytics drill (fixed seed: histogram + count-min tenants, 2 recurring epochs each, sqlite+HTTP; bit-exact sums, decoder errors within declared contracts)"
+ANA_RECORD=$(mktemp /tmp/sda-analytics-XXXX.json)
+ANA=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --analytics histogram,countmin \
+  --analytics-participants 4 --analytics-epochs 2 \
+  --analytics-store sqlite --analytics-http --analytics-seed 20260806)
+ANA="$ANA" ANA_RECORD="$ANA_RECORD" python - <<'PY'
+import json, os
+report = json.loads(os.environ["ANA"].strip().splitlines()[-1])
+# the analytics verdict: every tenant-epoch's revealed sum equals the
+# plaintext sum bit-exactly, and every decoded answer stays within the
+# encoder's declared error contract against the seeded ground truth
+assert report["exact"] is True, report
+assert report["rounds_exact"] == report["rounds"] == 4, report
+assert report["bounds_ok"] is True, report
+assert report["rounds_within_bounds"] == 4, report
+assert report["leaks"] == 0, report
+assert report["client_failures"] == 0, report
+# the multi-tenant scheduler drove every round: both schedules
+# installed, every epoch minted/closed through the cadence-gated tick
+sched = report["scheduler"]
+assert sched["installed"] == 2, sched
+assert sched["epochs_closed"] == 4, sched
+per = report["per_tenant"]
+hist = per["analytics-histogram-0"]
+cm = per["analytics-countmin-1"]
+# the exact encoder really was exact; the sketch stayed under eps*N
+# with zero delta-budget breaches and no count-min underestimates
+assert all(c["error"] == 0.0 for c in hist["checks"]), hist["checks"]
+assert all(c["error"] <= c["bound"] and c["underestimates"] == 0
+           and c["eps_violations"] <= c["delta_allowance"]
+           for c in cm["checks"]), cm["checks"]
+with open(os.environ["ANA_RECORD"], "w") as f:
+    json.dump(report, f)
+print(f"analytics drill OK: {report['rounds_exact']}/{report['rounds']} "
+      f"rounds exact, {report['rounds_within_bounds']} within contract, "
+      f"{report['value']} values/s")
+PY
+# the values/s record must parse as a bench record and gate (advisory:
+# first record of its metric seeds the trailing window)
+python -m sda_tpu.cli.bench --check --advisory BENCH_r*.json "$ANA_RECORD"
+rm -f "$ANA_RECORD"
+
 echo "== FL drill (fixed seed: LeNet secure FedAvg, 8 devices, ~25% churn, 1 dead clerk, sqlite+HTTP; target accuracy reached, bit-exact aggregate every round)"
 FL_RECORD=$(mktemp /tmp/sda-fl-XXXX.json)
 FL=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --fl --participants 8 \

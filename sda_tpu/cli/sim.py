@@ -9,11 +9,13 @@ line with timing and the verification verdict.
     sda-sim --participants 100 --dim 9999 --clerks 8
     sda-sim --participants 1000 --dim 3000000 --streaming
 
-Four no-JAX drill profiles exercise the serving plane instead of the
+Five no-JAX drill profiles exercise the serving plane instead of the
 kernels: ``--chaos`` (fault injection, chaos/drill.py), ``--load``
 (capacity measurement + admission control, loadgen/driver.py),
-``--tree`` (hierarchical population-scale rounds, sda_tpu/tree) and
-``--soak`` (continuous multi-tenant service, sda_tpu/service) — and the
+``--tree`` (hierarchical population-scale rounds, sda_tpu/tree),
+``--soak`` (continuous multi-tenant service, sda_tpu/service) and
+``--analytics`` (secure histograms / heavy hitters / quantiles / A/B
+metrics as multi-tenant recurring rounds, sda_tpu/analytics) — and the
 ``--fl`` profile runs the federated-learning scenario suite (secure
 FedAvg end-to-end over the full substrate, sda_tpu/fl; this one DOES
 use jax for local training):
@@ -21,6 +23,7 @@ use jax for local training):
     sda-sim --load --participants 200 --load-rps 150
     sda-sim --load --participants 200 --load-overload
     sda-sim --tree --participants 24 --tree-dropout 0.1
+    sda-sim --analytics histogram,countmin --analytics-epochs 3
     sda-sim --fl --participants 8 --fl-family lenet --fl-churn 0.25
 """
 
@@ -219,6 +222,63 @@ def build_parser() -> argparse.ArgumentParser:
                              "revealed round on the next sweep (--soak)")
     parser.add_argument("--soak-seed", type=int, default=0,
                         help="input/schedule/chaos seed (--soak)")
+    parser.add_argument("--analytics", metavar="PROFILE", default=None,
+                        help="federated-analytics profile: run each "
+                             "requested encoder kind as its own tenant of "
+                             "recurring scheduler-minted rounds over the "
+                             "real stack (sda_tpu/analytics) — secure "
+                             "histograms, count-min/count-sketch heavy "
+                             "hitters, quantiles, A/B metrics — asserting "
+                             "bit-exact reveals and decoder error within "
+                             "each encoder's declared contract; PROFILE "
+                             "is a comma list of histogram, countmin, "
+                             "countsketch, quantile, ab (aliases: heavy, "
+                             "all); prints the BENCH-style values/s "
+                             "record (docs/analytics.md)")
+    parser.add_argument("--analytics-tenants", type=int, metavar="T",
+                        default=None,
+                        help="tenants (recurring schedules); kinds cycle "
+                             "when T exceeds the profile list; default "
+                             "one per requested kind (--analytics)")
+    parser.add_argument("--analytics-participants", type=int, metavar="P",
+                        default=4,
+                        help="devices per tenant (>= 2) (--analytics)")
+    parser.add_argument("--analytics-epochs", type=int, metavar="R",
+                        default=2,
+                        help="recurring rounds per tenant (--analytics)")
+    parser.add_argument("--analytics-values", type=int, metavar="V",
+                        default=8,
+                        help="private values (samples/items) per device "
+                             "per epoch (--analytics)")
+    parser.add_argument("--analytics-domain", type=int, default=24,
+                        help="sketch item universe for heavy-hitter "
+                             "queries (--analytics)")
+    parser.add_argument("--analytics-bins", type=int, default=32,
+                        help="histogram/quantile grid bins (--analytics)")
+    parser.add_argument("--analytics-width", type=int, default=64,
+                        help="sketch width; eps = e/width (--analytics)")
+    parser.add_argument("--analytics-depth", type=int, default=4,
+                        help="sketch depth; count-min delta = e^-depth "
+                             "(--analytics)")
+    parser.add_argument("--analytics-store",
+                        choices=["memory", "sqlite", "jsonfs"],
+                        default="memory",
+                        help="server store backend for --analytics")
+    parser.add_argument("--analytics-http", action="store_true",
+                        help="drive devices over a real HTTP server "
+                             "instead of the in-process seam "
+                             "(--analytics)")
+    parser.add_argument("--analytics-fleet", type=int, metavar="N",
+                        default=0,
+                        help="drive the drill against N real sdad worker "
+                             "processes over one shared sqlite/jsonfs "
+                             "store (--analytics)")
+    parser.add_argument("--analytics-modulus-bits", type=int, default=28,
+                        help="packed-Shamir sharing prime size "
+                             "(--analytics)")
+    parser.add_argument("--analytics-seed", type=int, default=0,
+                        help="data/hash-family/schedule seed "
+                             "(--analytics)")
     parser.add_argument("--fl", action="store_true",
                         help="federated-learning profile: R rounds of "
                              "secure FedAvg over the full substrate "
@@ -806,6 +866,70 @@ def _run_soak(args) -> int:
     return 0 if ok else 1
 
 
+def _run_analytics(args) -> int:
+    """--analytics: the federated-analytics drill — each requested
+    encoder kind as its own tenant of recurring scheduler-minted rounds
+    over the real stack (sda_tpu/analytics/scenario.py), reported as one
+    BENCH-style JSON line whose headline is values/s. No mesh/JAX
+    involved: the encoders are integer-vector front-ends to the same
+    secure sum every serving drill exercises."""
+    import tempfile
+
+    from ..analytics import AnalyticsProfile, expand_kinds, run_analytics
+    from ..crypto import sodium
+
+    if not sodium.available():
+        print("error: --analytics needs libsodium (real-crypto rounds)",
+              file=sys.stderr)
+        return 1
+    try:
+        kinds = expand_kinds(args.analytics)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    store = args.analytics_store
+    if args.analytics_fleet and store == "memory":
+        print("note: fleet mode needs a cross-process store; using "
+              "--analytics-store sqlite", file=sys.stderr)
+        store = "sqlite"
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            report = run_analytics(AnalyticsProfile(
+                kinds=kinds,
+                tenants=args.analytics_tenants,
+                participants=args.analytics_participants,
+                epochs=args.analytics_epochs,
+                values_per_device=args.analytics_values,
+                domain_size=args.analytics_domain,
+                bins=args.analytics_bins,
+                width=args.analytics_width,
+                depth=args.analytics_depth,
+                seed=args.analytics_seed,
+                store=store,
+                store_path=None if store == "memory" else f"{tmp}/store",
+                http=args.analytics_http,
+                fleet=args.analytics_fleet,
+                modulus_bits=args.analytics_modulus_bits,
+            ))
+        except ValueError as e:
+            # FieldSizingError included: a misconfigured encoder is a
+            # typed refusal naming the contract, not a traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    _export_trace(args, report)
+    print(json.dumps(report))
+    # the analytics verdict: every tenant's every epoch revealed
+    # bit-exactly, every decoder stayed within its declared error
+    # contract, and nothing leaked across tenants
+    ok = (report["exact"]
+          and report["bounds_ok"]
+          and report["leaks"] == 0
+          and report["client_failures"] == 0)
+    if args.analytics_fleet:
+        ok = ok and report["fleet"]["leaked"] == 0
+    return 0 if ok else 1
+
+
 def _run_fl(args) -> int:
     """--fl: the federated-learning scenario — R rounds of secure FedAvg
     over the full substrate (sda_tpu/fl/scenario.py), reported as one
@@ -1076,6 +1200,25 @@ def main(argv=None) -> int:
 
     configure_logging(args.verbose)
 
+    if args.analytics and args.fl:
+        # two scenario suites, one process: whichever lost the dispatch
+        # would be silently ignored and mislabel the run — refuse
+        print("error: --analytics and --fl select different scenario "
+              "suites; run them as separate invocations",
+              file=sys.stderr)
+        return 1
+    if args.analytics and args.poison:
+        print("error: --poison arms the FL adversarial-input drill, not "
+              "--analytics (analytics encoders clamp adversarial values "
+              "by construction; see docs/analytics.md); drop --poison "
+              "or run --fl --poison", file=sys.stderr)
+        return 1
+    if args.analytics and args.devscale:
+        print("error: --analytics and --devscale select different "
+              "profiles (scheduled real-crypto rounds vs the model-scale "
+              "device-plane bench); run them as separate invocations",
+              file=sys.stderr)
+        return 1
     if args.poison and not args.fl:
         # a silently ignored attack knob would mislabel the run as an
         # adversarial drill that never attacked anything — refuse
@@ -1083,6 +1226,8 @@ def main(argv=None) -> int:
               "add --fl (no other profile trains on device inputs)",
               file=sys.stderr)
         return 1
+    if args.analytics:
+        return _run_analytics(args)
     if args.load:
         return _run_load(args)
     if args.pickup:

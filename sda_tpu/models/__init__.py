@@ -9,7 +9,13 @@ The flax-backed families load lazily (PEP 562) so the codec and the
 federated drivers work on installs without flax/optax.
 """
 
-from .encoding import FixedPointCodec, ravel_pytree
+from .encoding import (
+    FieldSizingError,
+    FixedPointCodec,
+    field_capacity,
+    field_headroom_check,
+    ravel_pytree,
+)
 from .federated import FederatedSession, LocalTrainer, pod_fedavg_round
 
 _FAMILY_EXPORTS = (
@@ -22,7 +28,10 @@ _FAMILY_EXPORTS = (
 )
 
 __all__ = [
+    "FieldSizingError",
     "FixedPointCodec",
+    "field_capacity",
+    "field_headroom_check",
     "ravel_pytree",
     "FederatedSession",
     "LocalTrainer",

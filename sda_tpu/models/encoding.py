@@ -22,7 +22,71 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["FixedPointCodec", "ravel_pytree"]
+__all__ = [
+    "FieldSizingError",
+    "FixedPointCodec",
+    "field_capacity",
+    "field_headroom_check",
+    "ravel_pytree",
+]
+
+
+class FieldSizingError(ValueError):
+    """A configuration whose worst-case aggregate could wrap the field.
+
+    Raised by :func:`field_headroom_check` — the one headroom rule shared
+    by :class:`FixedPointCodec` and every analytics encoder
+    (``sda_tpu/analytics``), so the two contracts cannot drift. A
+    subclass of ``ValueError`` so existing callers keep catching it.
+    """
+
+
+def field_capacity(modulus: int, max_summands: int) -> int:
+    """Largest per-coordinate magnitude the centered band can carry.
+
+    A sum of ``max_summands`` contributions each bounded by the returned
+    value stays strictly inside the decodable band ``|sum| <= m//2 - 1``
+    (centered lift, matching ``RecipientOutput.positive()``'s canonical
+    band shifted to (-m/2, m/2]).
+    """
+    if modulus < 3:
+        raise FieldSizingError(f"modulus {modulus} must be >= 3")
+    if max_summands < 1:
+        raise FieldSizingError(f"max_summands {max_summands} must be >= 1")
+    return (modulus // 2 - 1) // int(max_summands)
+
+
+def field_headroom_check(max_abs: int, max_summands: int, modulus: int,
+                         *, context: str = "") -> int:
+    """THE modulus-headroom rule: refuse configurations that could wrap.
+
+    Checks that the worst-case aggregate magnitude ``max_abs *
+    max_summands`` fits the centered decodable band of ``modulus`` and
+    returns the remaining margin (``m//2 - 1 - max_abs*max_summands``,
+    always >= 0 on success). Raises :class:`FieldSizingError` naming the
+    whole configuration otherwise — a misconfigured encoder is a typed
+    error at construction, never a silent wrap at decode.
+
+    ``context`` names the caller (e.g. ``"FixedPointCodec"`` or
+    ``"CountMinEncoder(width=64, depth=4)"``) so the error says WHICH
+    contract failed.
+    """
+    max_abs = int(max_abs)
+    if max_abs < 1:
+        raise FieldSizingError(
+            f"{context or 'field sizing'}: max per-coordinate contribution "
+            f"{max_abs} must be >= 1")
+    cap = field_capacity(modulus, max_summands)
+    margin = modulus // 2 - 1 - max_abs * int(max_summands)
+    if margin < 0:
+        raise FieldSizingError(
+            f"{context or 'field sizing'}: per-coordinate contribution up "
+            f"to {max_abs} x {max_summands} summands needs a decodable "
+            f"band of {max_abs * int(max_summands)}, but modulus {modulus} "
+            f"only carries |sum| <= {modulus // 2 - 1} "
+            f"(per-coordinate capacity {cap}): increase the modulus or "
+            f"lower max_summands")
+    return margin
 
 
 def ravel_pytree(tree):
@@ -107,9 +171,9 @@ class FixedPointCodec:
         self.fractional_bits = int(fractional_bits)
         self.scale = float(1 << self.fractional_bits)
         self.max_summands = int(max_summands)
-        q_cap = (modulus // 2 - 1) // self.max_summands
+        q_cap = field_capacity(modulus, self.max_summands)
         if q_cap < 1:
-            raise ValueError(
+            raise FieldSizingError(
                 f"modulus {modulus} has no headroom for {max_summands} "
                 f"summands: increase the modulus or lower max_summands"
             )
@@ -131,6 +195,11 @@ class FixedPointCodec:
                 raise ValueError("norm_clip must be positive")
         self.norm_clip = norm_clip
         self._q_max = int(round(self.clip * self.scale))
+        # seal the invariant through the SHARED headroom rule (the same
+        # one every analytics encoder calls), so the codec's capacity
+        # derivation above and the field contract cannot drift apart
+        field_headroom_check(max(1, self._q_max), self.max_summands,
+                             modulus, context="FixedPointCodec")
 
     @property
     def q_max(self) -> int:
@@ -176,22 +245,27 @@ class FixedPointCodec:
         centered, matching RecipientOutput.positive()'s canonical band
         (receive.rs:14-21) shifted to (-m/2, m/2].
         """
+        v = np.asarray(values, dtype=np.int64)
         if summands < 1:
             # a zero/negative summand count is always a caller bug (an
             # empty frozen set, a None participation count propagated
             # into the mean): fail typed here rather than as a
             # ZeroDivisionError inside decode_mean or a silently wrong
-            # "sum of zero things"
+            # "sum of zero things" — and name the aggregation context so
+            # the error is actionable from a decoder stack trace
             raise ValueError(
                 f"decode needs at least one summand, got {summands} "
-                "(empty frozen set? use the revealed participation count)"
+                f"(aggregation: dim {v.size}, modulus {self.modulus}, "
+                f"capacity {self.max_summands} summands; empty frozen "
+                "set? use the revealed participation count)"
             )
         if summands > self.max_summands:
             raise ValueError(
                 f"{summands} summands exceeds configured capacity "
-                f"{self.max_summands}; the sum may have wrapped"
+                f"{self.max_summands} (aggregation: dim {v.size}, "
+                f"modulus {self.modulus}); the sum may have wrapped"
             )
-        v = np.mod(np.asarray(values, dtype=np.int64), self.modulus)
+        v = np.mod(v, self.modulus)
         half = self.modulus // 2
         centered = v - np.where(v > half, self.modulus, 0)
         return centered.astype(np.float64) / self.scale
