@@ -373,6 +373,53 @@ PY
 python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$FLEET_RECORD"
 rm -f "$FLEET_RECORD"
 
+echo "== forensics drill (fixed seed: churn+chaos fleet round with the flight recorder on; every process exits, then sda-trace explain reconstructs the round from the spools alone)"
+SPOOL_DIR=$(mktemp -d /tmp/sda-spool-XXXX)
+FORENSICS_REPORT=$(env JAX_PLATFORMS=cpu SDA_FLIGHT_RECORDER="$SPOOL_DIR" \
+  python -m sda_tpu.cli.sim --load --participants 24 --dim 4 \
+  --load-arrivals closed --load-concurrency 8 --load-seed 20260803 \
+  --load-store sqlite --load-fleet 2 --load-chaos-rate 0.05 --load-churn 0.3)
+# the sim process and both fleet workers have exited: the JSONL spool
+# segments under $SPOOL_DIR are ALL that remains of the round's telemetry
+FORENSICS_REPORT="$FORENSICS_REPORT" SPOOL_DIR="$SPOOL_DIR" python - <<'PY'
+import json, os
+report = json.loads(os.environ["FORENSICS_REPORT"].strip().splitlines()[-1])
+# the recorder-on run itself must stay bit-exact (no protocol bytes change)
+assert report["ready"] and report["exact"], report
+assert report["output_sha256"], report
+from sda_tpu.obs import forensics
+spool = forensics.load_spool(os.environ["SPOOL_DIR"])
+rep = forensics.explain(spool, report["aggregation"])
+# all three processes (sim swarm + 2 sdad workers) spooled segments
+assert len(rep["processes"]) >= 3, rep["processes"]
+# the round story is complete: every admitted participation visible,
+# the ledger reaches revealed, chaos faults attributed site+kind
+assert rep["participations"]["created"] == report["admitted_participations"], \
+    (rep["participations"], report["admitted_participations"])
+assert rep["final_state"] == "revealed", rep["states"]
+assert rep["faults"], "no chaos faults attributed in the spools"
+assert all(f["site"] and f["kind"] for f in rep["faults"]), rep["faults"]
+# bit-exact reveal recorded: the spooled reveal span's digest matches the
+# loadgen oracle's digest of the expected plaintext sum
+assert rep["reveal"] and rep["reveal"]["output_sha256"] == report["output_sha256"], \
+    (rep["reveal"], report["output_sha256"])
+print(f"forensics drill OK: {rep['spans']} spans from "
+      f"{len(rep['processes'])} dead processes, "
+      f"{rep['participations']['created']} participations, "
+      f"{len(rep['faults'])} faults attributed, states "
+      f"{'->'.join(s['state'] for s in rep['states'])}, reveal digest match")
+PY
+# the CLI spelling must agree with the library pass (and exit 0)
+env SDA_FLIGHT_RECORDER="$SPOOL_DIR" python -m sda_tpu.cli.tracecli segments > /dev/null
+env SDA_FLIGHT_RECORDER="$SPOOL_DIR" python -m sda_tpu.cli.tracecli slo > /dev/null
+rm -rf "$SPOOL_DIR"
+
+echo "== recorder overhead bench (span hot path, recorder off vs on; BENCH record gated advisory)"
+REC_RECORD=$(mktemp /tmp/sda-recbench-XXXX.json)
+python -m sda_tpu.loadgen.recorderbench --spans 20000 --max-overhead-pct 400 > "$REC_RECORD"
+python -m sda_tpu.obs.regress --advisory BENCH_r*.json "$REC_RECORD"
+rm -f "$REC_RECORD"
+
 echo "== soak drill (fixed seed: 2 tenants x 3 pipelined epochs, sqlite + HTTP fleet of 2, ~10% chaos, churn armed; bit-exact per epoch, flat store after retention)"
 SOAK_RECORD=$(mktemp /tmp/sda-soak-XXXX.json)
 SOAK=$(env JAX_PLATFORMS=cpu python -m sda_tpu.cli.sim --soak \

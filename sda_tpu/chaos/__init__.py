@@ -333,10 +333,23 @@ class FailpointRegistry:
                 return None
         metrics.count(f"chaos.{name}")
         # stamp the injection on the active span (no-op without one): a
-        # trace timeline then shows WHICH injected fault hit WHICH round
+        # trace timeline then shows WHICH injected fault hit WHICH round.
+        # fault.kind/fault.site are the structured tags sda-trace explain
+        # joins on; the bare "kind" attr stays for older consumers.
         from .. import obs
+        from ..obs import recorder, trace
 
-        obs.add_event(f"chaos.{name}", kind=action.kind)
+        obs.add_event(f"chaos.{name}", kind=action.kind,
+                      **{"fault.kind": action.kind, "fault.site": name})
+        ctx_span = trace.current_span()
+        recorder.record({
+            "t": "fault",
+            "site": name,
+            "kind": action.kind,
+            "node": self._identity,
+            "trace": ctx_span.trace_id if ctx_span else None,
+            "span": ctx_span.span_id if ctx_span else None,
+        })
         return action
 
     def fail(self, name: str) -> Optional[Action]:

@@ -195,6 +195,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "sda_tpu.chaos.configure_from_specs)")
     parser.add_argument("--chaos-seed", type=int, default=0,
                         help="failpoint schedule seed (--chaos-spec)")
+    parser.add_argument("--flight-recorder", metavar="DIR", default=None,
+                        help="spool finished spans, round-ledger entries "
+                             "and periodic metric snapshots into bounded "
+                             "JSONL segments under DIR (crash-safe; "
+                             "sda-trace reads them post-mortem). "
+                             "Equivalent to SDA_FLIGHT_RECORDER=DIR; "
+                             "changes no protocol bytes")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     sub = parser.add_subparsers(dest="command", required=True)
     httpd = sub.add_parser("httpd")
@@ -207,6 +214,15 @@ def main(argv=None) -> int:
     from ..utils import configure_logging
 
     configure_logging(args.verbose)
+    from ..obs import recorder as flight_recorder
+
+    if args.flight_recorder:
+        # the flag is sugar for the env knob, so a fleet parent that
+        # passes --flight-recorder still propagates it to spawned peers
+        import os as _os
+
+        _os.environ[flight_recorder.RECORDER_DIR_ENV] = args.flight_recorder
+    flight_recorder.maybe_install_from_env(node_id=args.node_id)
     from ..http import server_class
     from ..server import (
         new_jsonfs_server,

@@ -1196,9 +1196,14 @@ def _run_chaos(args) -> int:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from .. import obs
+    from ..obs import recorder as flight_recorder
     from ..utils import configure_logging, counter_report, phase_report
 
     configure_logging(args.verbose)
+    # one-knob flight recorder: SDA_FLIGHT_RECORDER=DIR spools this
+    # process's spans/rounds/metrics; spawned fleet workers inherit the
+    # env and spool beside it (sda-trace merges the segments)
+    flight_recorder.maybe_install_from_env(node_id="sim")
 
     if args.analytics and args.fl:
         # two scenario suites, one process: whichever lost the dispatch

@@ -9,6 +9,7 @@ Participating/Clerking/Receiving/Maintenance traits).
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import threading
@@ -92,6 +93,28 @@ class RecipientOutput:
         return (f"RecipientOutput(modulus={self.modulus}, "
                 f"values={self.values!r}, "
                 f"participations={self.participations})")
+
+
+#: Above this many elements the reveal-span digest is skipped: hashing a
+#: dim-1e8 output would add seconds to the reveal for a forensics nicety.
+OUTPUT_DIGEST_MAX_ELEMENTS = 1 << 22
+
+
+def output_digest(output: "RecipientOutput") -> Optional[str]:
+    """Canonical sha256 of a revealed output: positive representatives in
+    ``[0, modulus)``, int64 little-endian bytes on the vectorized lane,
+    decimal-string join on the bigint lane. The reveal span records this
+    and loadgen recomputes it from its oracle, so a spool-only forensics
+    pass (``sda-trace explain``) can assert bit-exactness."""
+    values = output.positive().values
+    if values.size > OUTPUT_DIGEST_MAX_ELEMENTS:
+        return None
+    if values.dtype == object:
+        payload = ",".join(str(int(v)) for v in values.ravel()).encode()
+    else:
+        payload = np.ascontiguousarray(
+            values, dtype="<i8").tobytes()
+    return hashlib.sha256(payload).hexdigest()
 
 
 def _committee_key_variant(aggregation: Aggregation) -> str:
@@ -1014,7 +1037,15 @@ ParticipationJournal`), the fully sealed bundle is persisted BEFORE the
         round; default is the first result-ready snapshot (receive.rs:91-94)."""
         with obs.span("recipient.reveal",
                       attributes={"aggregation": str(aggregation_id)}):
-            return self._reveal_aggregation(aggregation_id, snapshot_id)
+            output = self._reveal_aggregation(aggregation_id, snapshot_id)
+            # stamp the canonical output digest on the span: the flight
+            # recorder spools it, so a forensics pass can assert the
+            # revealed round was bit-exact after every process has exited
+            digest = output_digest(output)
+            if digest is not None:
+                obs.set_attribute("output.sha256", digest)
+                obs.set_attribute("output.dim", int(output.values.size))
+            return output
 
     def _reveal_aggregation(
         self, aggregation_id: AggregationId, snapshot_id: Optional[SnapshotId]

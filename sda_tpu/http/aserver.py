@@ -55,6 +55,7 @@ from ..server.routing import NODE_HEADER
 from ..utils import metrics
 from ..utils.env import env_float
 from .. import chaos, obs
+from ..obs import recorder
 from . import base
 from .admission import AdmissionControl, TENANT_HEADER
 from .server import trace_log
@@ -535,6 +536,10 @@ class SdaAsyncHttpServer:
                 # time so cross-plane trace timelines agree (the threaded
                 # plane holds its span open through blocking_park)
                 span.duration_s = time.perf_counter() - rx.t0
+                # the flight recorder already spooled the short pre-park
+                # span at close; re-spool the amended one — forensics
+                # dedupes by span id keeping the longest duration
+                recorder.amend_span(span)
             if self.trace_log:
                 trace_log.info(
                     "trace %s %s %s status=%s request_id=%s",

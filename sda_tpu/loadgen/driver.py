@@ -133,7 +133,7 @@ def run_load(profile: LoadProfile) -> dict:
     capacity report. Requires libsodium (real participant crypto)."""
     import numpy as np
 
-    from ..client import SdaClient
+    from ..client import RecipientOutput, SdaClient, output_digest
     from ..crypto import MemoryKeystore, sodium
     from ..http import SdaHttpClient, SdaHttpServer
     from ..protocol import (
@@ -508,6 +508,7 @@ def run_load(profile: LoadProfile) -> dict:
                 time.sleep(0.05)
 
             exact = False
+            expected_digest = None
             admitted_participations = None
             if status is not None:
                 admitted_participations = status.number_of_participations
@@ -520,6 +521,12 @@ def run_load(profile: LoadProfile) -> dict:
                 output = recipient.reveal_aggregation(agg.id)
                 expected = inputs.sum(axis=0) % scheme.prime_modulus
                 exact = bool((output.positive().values == expected).all())
+                # the oracle's digest, computed the same canonical way the
+                # reveal span stamps output.sha256: a forensics pass over
+                # the spools alone can then assert the recorded reveal was
+                # bit-exact (ci.sh forensics drill)
+                expected_digest = output_digest(
+                    RecipientOutput(scheme.prime_modulus, expected))
     finally:
         failpoint_report = chaos.report()
         chaos.reset()
@@ -645,6 +652,11 @@ def run_load(profile: LoadProfile) -> dict:
         "admitted_participations": admitted_participations,
         "ready": ready,
         "exact": exact,
+        # join keys for post-mortem forensics: sda-trace explain takes the
+        # aggregation id, and the oracle digest must match the reveal
+        # span's spooled output.sha256 attribute
+        "aggregation": str(agg.id),
+        "output_sha256": expected_digest,
         "load_seconds": round(load_elapsed, 4),
         "round_seconds": round(total_elapsed, 4),
         "sustained_rps": round(load_requests / load_elapsed, 1)
@@ -799,12 +811,19 @@ def run_fleet_scaling(profile: LoadProfile, nodes: int,
         "ready": bool(base["ready"] and top["ready"]),
         "client_failures": base["client_failures"] + top["client_failures"],
         "leaked": base["fleet"]["leaked"] + top["fleet"]["leaked"],
+        # forensics join keys of the TOP rung (the fleet round the drill
+        # is named for): sda-trace explain takes the aggregation id, and
+        # the oracle digest is asserted against the spooled reveal span
+        "aggregation": top.get("aggregation"),
+        "output_sha256": top.get("output_sha256"),
+        "admitted_participations": top.get("admitted_participations"),
         "rungs": {
             str(n): {
                 key: rep.get(key)
                 for key in ("sustained_rps", "load_seconds", "round_seconds",
                             "load_requests", "requests", "completed",
-                            "shed_429", "errors_5xx", "exact", "ready")
+                            "shed_429", "errors_5xx", "exact", "ready",
+                            "aggregation")
             }
             for n, rep in reports.items()
         },

@@ -28,16 +28,21 @@ from .trace import (
     reset_spans,
     seed_ids,
     set_attribute,
+    set_span_sink,
     span,
+    span_sink,
 )
 from .timeline import (
+    chrome_trace_from_records,
+    clock_offsets,
     critical_path,
     merge_chrome_traces,
+    normalize_span_records,
     round_timelines,
     slowest_spans,
     span_tree,
 )
-from . import devprof
+from . import devprof, recorder
 
 __all__ = [
     "REQUEST_ID_HEADER",
@@ -48,6 +53,8 @@ __all__ = [
     "SpanContext",
     "add_event",
     "chrome_trace",
+    "chrome_trace_from_records",
+    "clock_offsets",
     "critical_path",
     "devprof",
     "current_context",
@@ -59,14 +66,18 @@ __all__ = [
     "link_job",
     "merge_chrome_traces",
     "new_request_id",
+    "normalize_span_records",
     "parse_traceparent",
+    "recorder",
     "reset_all",
     "reset_spans",
     "round_timelines",
     "seed_ids",
     "set_attribute",
+    "set_span_sink",
     "slowest_spans",
     "span",
+    "span_sink",
     "span_tree",
 ]
 

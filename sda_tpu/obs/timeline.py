@@ -126,6 +126,94 @@ def slowest_spans(
     ]
 
 
+def clock_offsets(anchors: List[dict]) -> Dict[tuple, float]:
+    """Per-process clock offsets from spool ``proc`` anchor records.
+
+    Python's ``perf_counter`` epoch is unspecified and per-process, so
+    monotonic timestamps from two fleet workers are NOT comparable — and
+    wall clocks can step mid-run, so wall stamps alone interleave events
+    wrongly on skewed hosts. Each flight-recorder segment opens with an
+    anchor pairing ``wall_s`` and ``mono_s`` sampled back-to-back; for
+    process ``(node, pid)`` the offset is ``wall_anchor - mono_anchor``,
+    and any of that process's monotonic stamps normalizes to a shared
+    timeline as ``mono + offset``. With several anchors per process (one
+    per segment) we keep the EARLIEST: later anchors would silently fold
+    any wall-clock step into the offset and shear the merged timeline.
+
+    Returns ``{(node_or_None, pid): offset_s}``.
+    """
+    offsets: Dict[tuple, tuple] = {}  # key -> (mono_anchor, offset)
+    for rec in anchors:
+        if rec.get("t") != "proc":
+            continue
+        wall = rec.get("wall_s")
+        mono = rec.get("mono_s")
+        if wall is None or mono is None:
+            continue
+        key = (rec.get("node"), rec.get("pid"))
+        prev = offsets.get(key)
+        if prev is None or mono < prev[0]:
+            offsets[key] = (mono, wall - mono)
+    return {key: off for key, (_, off) in offsets.items()}
+
+
+def normalize_span_records(records: List[dict]) -> List[dict]:
+    """Rewrite spooled span records from N processes onto one wall-clock
+    timeline: each span's ``start_s`` becomes ``mono_s + offset`` of its
+    process (falling back to the recorded wall stamp when the segment's
+    anchor or the span's monotonic stamp is missing). Input records need
+    a ``node``/``pid`` stamp or ride in segments whose anchor provides
+    them — the forensics loader (``obs/forensics.py``) annotates both."""
+    offsets = clock_offsets(records)
+    out = []
+    for rec in records:
+        if rec.get("t") != "span":
+            continue
+        rec = dict(rec)
+        key = (rec.get("node"), rec.get("pid"))
+        off = offsets.get(key)
+        mono = rec.get("mono_s")
+        if off is not None and mono is not None:
+            rec["norm_s"] = mono + off
+        else:
+            rec["norm_s"] = rec.get("start_s", 0.0)
+        out.append(rec)
+    out.sort(key=lambda r: r["norm_s"])
+    return out
+
+
+def chrome_trace_from_records(records: List[dict]) -> dict:
+    """Chrome ``traceEvents`` dict from spooled span records, one pid
+    lane per recording process, timestamps normalized via
+    :func:`clock_offsets` so two workers' lanes truly interleave in
+    causal order (satellite of the flight-recorder plane; load in
+    ``chrome://tracing`` / Perfetto)."""
+    events = []
+    pids: Dict[tuple, int] = {}
+    for rec in normalize_span_records(records):
+        key = (rec.get("node"), rec.get("pid"))
+        pid = pids.setdefault(key, len(pids) + 1)
+        events.append({
+            "name": rec.get("name", "?"),
+            "ph": "X",
+            "ts": rec["norm_s"] * 1e6,
+            "dur": (rec.get("duration_s") or 0.0) * 1e6,
+            "pid": pid,
+            "tid": rec.get("thread", 0),
+            "args": {
+                "trace_id": rec.get("trace"),
+                "span_id": rec.get("span"),
+                **{k: str(v) for k, v in (rec.get("attrs") or {}).items()},
+            },
+        })
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": f"{node or 'proc'}[{rpid}]"}}
+        for (node, rpid), pid in sorted(pids.items(), key=lambda kv: kv[1])
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
 def merge_chrome_traces(*traces: dict) -> dict:
     """Concatenate Chrome trace dicts (e.g. the span export plus a
     ``jax.profiler`` device trace loaded via ``traceparse``), remapping

@@ -105,7 +105,8 @@ class Span:
 
     __slots__ = (
         "name", "trace_id", "span_id", "parent_id", "kind",
-        "start_s", "duration_s", "attributes", "events", "status", "thread",
+        "start_s", "start_mono", "duration_s", "attributes", "events",
+        "status", "thread",
     )
 
     def __init__(self, name, trace_id, span_id, parent_id, kind, attributes):
@@ -115,6 +116,11 @@ class Span:
         self.parent_id = parent_id
         self.kind = kind  # "internal" | "client" | "server"
         self.start_s = time.time()
+        #: ``perf_counter`` at open — the flight recorder spools it next
+        #: to the wall stamp so cross-process merges can normalize each
+        #: process's monotonic epoch against its wall-clock anchor
+        #: (``timeline.clock_offsets``); set by ``span()``
+        self.start_mono: Optional[float] = None
         self.duration_s: Optional[float] = None
         self.attributes: Dict[str, object] = dict(attributes or {})
         self.events: List[dict] = []
@@ -168,6 +174,10 @@ class _IdSource:
 _ids = _IdSource()
 _buffer: "collections.deque[Span]" = collections.deque(maxlen=SPAN_BUFFER_CAPACITY)
 _buffer_lock = threading.Lock()
+#: Optional finished-span hook (the flight recorder's spool writer): called
+#: with each Span as it closes, AFTER the ring-buffer append. Exceptions
+#: are swallowed — the sink observes, it never participates.
+_span_sink = None
 _tls = threading.local()
 _job_links: "collections.OrderedDict[str, SpanContext]" = collections.OrderedDict()
 _job_links_lock = threading.Lock()
@@ -231,6 +241,7 @@ def span(
     stack = _stack()
     stack.append(span_)
     t0 = time.perf_counter()
+    span_.start_mono = t0
     try:
         yield span_
     except BaseException as e:
@@ -242,6 +253,12 @@ def span(
         stack.pop()
         with _buffer_lock:
             _buffer.append(span_)
+        sink = _span_sink
+        if sink is not None:
+            try:
+                sink(span_)
+            except Exception:  # a broken sink must never fail the span's
+                pass  # owner — observability stays side-effect-free
 
 
 def add_event(name: str, **attributes) -> None:
@@ -271,6 +288,18 @@ def reset_spans() -> None:
         _buffer.clear()
     with _job_links_lock:
         _job_links.clear()
+
+
+def set_span_sink(sink) -> None:
+    """Install (or, with ``None``, remove) the finished-span hook. One
+    sink at a time — the flight recorder owns it when installed."""
+    global _span_sink
+    _span_sink = sink
+
+
+def span_sink():
+    """The current finished-span hook, or None."""
+    return _span_sink
 
 
 # -- propagation ------------------------------------------------------------

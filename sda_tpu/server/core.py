@@ -214,7 +214,9 @@ class SdaServer:
     # -- participation -----------------------------------------------------
     def create_participation(self, participation: Participation) -> None:
         with obs.span("server.create_participation",
-                      attributes={"participation": str(participation.id)}
+                      attributes={"participation": str(participation.id),
+                                  "aggregation":
+                                  str(participation.aggregation)}
                       ) as span:
             try:
                 created = self.aggregation_store.create_participation(
@@ -226,13 +228,15 @@ class SdaServer:
                 span.set_attribute("conflict", True)
                 metrics.count("server.participation.equivocation")
                 raise
-        if created is False:
-            # byte-identical replay (crash/retry or journal resume):
-            # idempotent success, nothing changed
-            metrics.count("server.participation.replayed")
-        else:
-            # True, or None from a pre-exactly-once third-party store
-            metrics.count("server.participation.created")
+            if created is False:
+                # byte-identical replay (crash/retry or journal resume):
+                # idempotent success, nothing changed — tagged so a
+                # forensics pass counts distinct participations exactly
+                span.set_attribute("replayed", True)
+                metrics.count("server.participation.replayed")
+            else:
+                # True, or None from a pre-exactly-once third-party store
+                metrics.count("server.participation.created")
 
     # -- status / snapshots ------------------------------------------------
     def get_aggregation_status(

@@ -58,6 +58,7 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 from .. import obs
+from ..obs import recorder
 from ..utils import metrics
 from ..protocol import (
     AdditiveSharing,
@@ -175,6 +176,16 @@ def transition(store, aggregation, from_states, state: str, **changes) -> bool:
     metrics.count(f"server.round.state.{state}")
     obs.add_event(f"round.{state}", aggregation=str(aggregation),
                   previous=doc.get("state"))
+    # durable round ledger: the flight recorder spools every transition
+    # so sda-trace can replay the state story after the fleet is gone
+    recorder.record({
+        "t": "round",
+        "aggregation": str(aggregation),
+        "state": state,
+        "previous": doc.get("state"),
+        **({"reason": changes["reason"]} if changes.get("reason") else {}),
+        **({"tenant": doc["tenant"]} if doc.get("tenant") else {}),
+    })
     return True
 
 
@@ -194,6 +205,15 @@ def note_collecting(server, aggregation) -> None:
     server.aggregation_store.put_round_state(
         new_round_doc(aggregation, getattr(server, "round_deadlines", None)))
     metrics.count("server.round.state.collecting")
+    recorder.record({
+        "t": "round",
+        "aggregation": str(aggregation.id),
+        "state": "collecting",
+        "previous": None,
+        # the round's tenant: recipients are the scheduler's tenant key
+        # (service/scheduler.py), which sda-trace slo groups budgets by
+        "tenant": str(aggregation.recipient),
+    })
 
 
 def note_frozen(server, aggregation, snapshot_id) -> None:

@@ -46,6 +46,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from .. import obs
+from ..obs import recorder
 from ..protocol import (
     Aggregation,
     AggregationId,
@@ -290,6 +291,14 @@ class RoundScheduler:
         metrics.count("service.schedule.epoch_minted")
         obs.add_event("schedule.epoch_minted", schedule=spec.name,
                       epoch=epoch + 1)
+        recorder.record({
+            "t": "epoch",
+            "action": "minted",
+            "schedule": spec.name,
+            "tenant": spec.tenant,
+            "epoch": epoch + 1,
+            "aggregation": str(epoch_aggregation_id(spec.name, epoch + 1)),
+        })
         actions.append({"schedule": spec.name, "action": "minted",
                         "epoch": epoch + 1})
         # mint FIRST, close second: epoch e+1 must already be collecting
@@ -350,6 +359,14 @@ class RoundScheduler:
         metrics.count("service.schedule.epoch_closed")
         obs.add_event("schedule.epoch_closed", schedule=spec.name,
                       epoch=epoch)
+        recorder.record({
+            "t": "epoch",
+            "action": "closed",
+            "schedule": spec.name,
+            "tenant": spec.tenant,
+            "epoch": epoch,
+            "aggregation": str(aggregation_id),
+        })
         return [{"schedule": spec.name, "action": "closed", "epoch": epoch,
                  "snapshot": str(snapshot_id)}]
 

@@ -196,6 +196,73 @@ def _escape_label(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def unescape_label(value: str) -> str:
+    """Invert :func:`_escape_label` — the round-trip parsers of the text
+    exposition (tests, scrape tooling) rely on. Escapes are processed
+    left-to-right, exactly as Prometheus label-value unescaping does."""
+    out = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+            if nxt == '"':
+                out.append('"')
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _bucket_rows(buckets: Dict[int, int], count: int):
+    """Cumulative ``[le_string, cumulative_count]`` rows for one
+    histogram, ``+Inf`` last — THE bucket-boundary format. Both the text
+    exposition (``prometheus_text``'s ``_bucket``/``le`` lines) and the
+    flight recorder's spooled metric snapshots (``snapshot()``) render
+    from this one helper, so the scrape endpoint and the on-disk spools
+    can never disagree about boundary formatting."""
+    rows = []
+    cumulative = 0
+    for idx in sorted(buckets):
+        cumulative += buckets[idx]
+        rows.append(["%.6g" % (HIST_MIN * HIST_BASE ** idx), cumulative])
+    rows.append(["+Inf", count])
+    return rows
+
+
+def snapshot() -> dict:
+    """One consistent point-in-time view of every instrument: counters,
+    gauges, and histograms WITH explicit bucket boundaries (the same
+    ``le`` strings ``prometheus_text`` emits). This is the record shape
+    the flight recorder spools (``obs/recorder.py``), taken under the
+    registry lock so bucket rows stay consistent with their _sum/_count."""
+    with _lock:
+        counts = dict(_counts)
+        gauges = dict(_gauges)
+        hists = [
+            (name, dict(h.buckets), h.count, h.total)
+            for name, h in sorted(_hists.items())
+        ]
+    return {
+        "counters": counts,
+        "gauges": gauges,
+        "histograms": {
+            name: {"count": count_, "sum": total,
+                   "buckets": _bucket_rows(buckets, count_)}
+            for name, buckets, count_, total in hists
+        },
+    }
+
+
 def prometheus_text(labels: Optional[Dict[str, str]] = None) -> str:
     """Render every instrument in the Prometheus text exposition format.
 
@@ -235,14 +302,9 @@ def prometheus_text(labels: Optional[Dict[str, str]] = None) -> str:
         lines.append("# TYPE sda_histogram histogram")
         for name, buckets, count_, total in hists:
             label = _escape_label(name)
-            cumulative = 0
-            for idx in sorted(buckets):
-                cumulative += buckets[idx]
-                bound = HIST_MIN * HIST_BASE ** idx
-                lines.append('sda_histogram_bucket{name="%s"%s,le="%.6g"} %d'
-                             % (label, extra, bound, cumulative))
-            lines.append('sda_histogram_bucket{name="%s"%s,le="+Inf"} %d'
-                         % (label, extra, count_))
+            for le, cumulative in _bucket_rows(buckets, count_):
+                lines.append('sda_histogram_bucket{name="%s"%s,le="%s"} %d'
+                             % (label, extra, le, cumulative))
             lines.append('sda_histogram_sum{name="%s"%s} %.9g'
                          % (label, extra, total))
             lines.append('sda_histogram_count{name="%s"%s} %d'

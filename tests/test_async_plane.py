@@ -646,3 +646,73 @@ def test_granted_lease_sweep_shared_and_statusz_held(plane):
             "lease"]["held"] == 0
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# W3C context over the async seam + observability-endpoint exemptions
+# (flight-recorder plane satellites: the cross-process joins that let
+# sda-trace explain stitch a round from many processes' spools)
+
+def test_traceparent_joins_parked_longpoll_pickup(plane):
+    """A clerk's long-poll carries its traceparent across the wire; the
+    server span joins the clerk's trace even when the request PARKS and
+    resolves on a wakeup hop — and the recorded server-span duration
+    covers the parked time (the async plane amends the span after its
+    deferred completion), so a forensics timeline shows the real wait."""
+    server = start_server(plane)
+    server.sda_service.server.clerking_lease_seconds = 30.0
+    try:
+        proxy, recipient, clerks, agg = proxied_world(server)
+        participate_one(proxy, agg)
+        clerk_agent = clerks[0][0]
+        got = {}
+
+        def parked_poll():
+            with obs.span("clerk.pickup-root") as root:
+                got["trace"] = root.trace_id
+                got["job"] = proxy.await_clerking_job(
+                    clerk_agent, clerk_agent.id, wait_s=20.0)
+
+        t = threading.Thread(target=parked_poll, daemon=True)
+        t.start()
+        time.sleep(0.4)  # let the request park server-side
+        snapshot(proxy, recipient, agg)  # fan-out fires the wakeup
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert got["job"] is not None
+        joined = [s for s in obs.finished_spans()
+                  if s.name.startswith("http.server")
+                  and s.trace_id == got["trace"]]
+        assert joined, "server spans must join the clerk's trace"
+        parked = max(joined, key=lambda s: s.duration_s or 0.0)
+        assert parked.attributes["http.route"].startswith("GET:")
+        assert (parked.duration_s or 0.0) >= 0.3
+    finally:
+        server.shutdown()
+
+
+def test_metrics_statusz_admission_and_tracing_exempt_under_load(plane):
+    """/metrics and /statusz must answer during the exact overload they
+    diagnose: with the rate limiter drained so ordinary requests shed
+    429, every scrape still lands 200 — and none of them mint a server
+    span (a scrape loop must not churn the ring buffer or the spools)."""
+    server = start_server(plane, metrics_endpoint=True,
+                          statusz_endpoint=True,
+                          rate_limit=0.001, rate_burst=1.0)
+    try:
+        # burn the single admission token, then prove the limiter bites
+        statuses = [requests.get(server.address + "/v1/ping").status_code
+                    for _ in range(4)]
+        assert 429 in statuses
+        for _ in range(20):
+            m = requests.get(server.address + "/metrics")
+            assert m.status_code == 200
+            assert "sda_events_total" in m.text
+            z = requests.get(server.address + "/statusz")
+            assert z.status_code == 200
+            assert "admission" in z.json()
+        scraped = [s for s in obs.finished_spans()
+                   if "/metrics" in s.name or "statusz" in s.name]
+        assert scraped == [], "observability endpoints must not be traced"
+    finally:
+        server.shutdown()
